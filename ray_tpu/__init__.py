@@ -1,0 +1,61 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+A brand-new framework with the capabilities of Ray (reference:
+``/root/reference``), designed JAX/XLA-first: a control store + per-host
+scheduler agents + per-process core workers provide tasks, actors, objects
+and placement groups (reference layer map: SURVEY.md §1); TPU chips and ICI
+slice topology are first-class scheduler resources; collectives are XLA mesh
+operations; and parallelism strategies (DP/FSDP/TP/PP/CP) are provided
+natively via pjit/shard_map rather than delegated to external engines.
+
+Public core API parity target: ``ray.init/remote/get/put/wait/kill/cancel``
+(reference: python/ray/_private/worker.py:1388,2831,2982,3053,3233,3277 and
+``@ray.remote`` worker.py:3453).
+"""
+
+from ray_tpu._version import __version__
+
+# Core public API (lazily bound to keep `import ray_tpu` light — no JAX
+# import unless a JAX-facing subpackage is used).
+from ray_tpu.core.api import (
+    cancel,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    method,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.placement import (
+    PlacementGroup,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.core import exceptions
+
+__all__ = [
+    "__version__",
+    "ObjectRef",
+    "PlacementGroup",
+    "cancel",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "placement_group",
+    "put",
+    "remote",
+    "remove_placement_group",
+    "shutdown",
+    "wait",
+]

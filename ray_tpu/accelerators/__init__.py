@@ -1,0 +1,51 @@
+"""Accelerator discovery plugins.
+
+Parity: the reference's per-vendor accelerator managers
+(python/ray/_private/accelerators/__init__.py). Here TPU is the first-class
+citizen; a generic CPU fallback covers everything else.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+
+def detect_node_resources_and_labels() -> Tuple[Dict[str, float], Dict[str, str]]:
+    """Resources + labels this host contributes to the cluster."""
+    resources: Dict[str, float] = {
+        "CPU": float(os.environ.get("RT_NUM_CPUS", os.cpu_count() or 1)),
+        "memory": float(_total_memory_bytes()),
+    }
+    labels: Dict[str, str] = {}
+    tpu = TPUAcceleratorManager
+    num_chips = tpu.get_current_node_num_accelerators()
+    if num_chips > 0:
+        resources["TPU"] = float(num_chips)
+        pod_type = tpu.get_current_pod_type()
+        if pod_type:
+            labels["tpu-pod-type"] = pod_type
+            # Whole-slice gang scheduling marker (reference: the
+            # "TPU-{pod_type}-head" resource, accelerators/tpu.py:450-563).
+            if tpu.get_current_worker_id() in (None, 0):
+                resources[f"TPU-{pod_type}-head"] = 1.0
+        topology = tpu.get_current_topology()
+        if topology:
+            labels["tpu-topology"] = topology
+        worker_id = tpu.get_current_worker_id()
+        if worker_id is not None:
+            labels["tpu-worker-id"] = str(worker_id)
+    return resources, labels
+
+
+def _total_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 8 << 30

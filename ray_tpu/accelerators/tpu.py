@@ -1,0 +1,155 @@
+"""TPU accelerator discovery + visibility management.
+
+Parity: the reference's TPUAcceleratorManager
+(python/ray/_private/accelerators/tpu.py:291): chip-count discovery, GCE
+metadata pod-type/topology/worker-id detection (:450-563), the
+``TPU_VISIBLE_CHIPS`` visibility env, and the per-pod-type head resource
+used for whole-slice gang scheduling (util/tpu.py:225,460).
+
+Discovery order for chip count:
+  1. RT_NUM_TPUS env (explicit override)
+  2. TPU_VISIBLE_CHIPS env (visibility restriction)
+  3. /dev/accel* or /dev/vfio device files (local chips)
+  4. GCE TPU-VM metadata server (accelerator-type → chips per host)
+None found → 0 (CPU-only node).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import urllib.request
+from typing import List, Optional
+
+_GCE_METADATA_URL = "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
+
+# chips per host for common TPU VM generations
+_CHIPS_PER_HOST = {
+    "v2": 4, "v3": 4, "v4": 4, "v5litepod": 4, "v5e": 4, "v5p": 4, "v6e": 4,
+}
+
+TPU_VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+NUM_TPUS_ENV = "RT_NUM_TPUS"
+
+
+def _metadata(key: str) -> Optional[str]:
+    if os.environ.get("TPU_SKIP_MDS_QUERY"):
+        return None
+    try:
+        req = urllib.request.Request(
+            _GCE_METADATA_URL + key, headers={"Metadata-Flavor": "Google"}
+        )
+        with urllib.request.urlopen(req, timeout=0.5) as resp:
+            return resp.read().decode()
+    except Exception:
+        return None
+
+
+class TPUAcceleratorManager:
+    """Static discovery/visibility helpers (mirrors the reference's API)."""
+
+    @staticmethod
+    def get_resource_name() -> str:
+        return "TPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return TPU_VISIBLE_CHIPS_ENV
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        explicit = os.environ.get(NUM_TPUS_ENV)
+        if explicit is not None:
+            return int(explicit)
+        visible = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
+        if visible:
+            return len([c for c in visible.split(",") if c.strip()])
+        devices = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/[0-9]*")
+        if devices:
+            return len(devices)
+        accel_type = _metadata("accelerator-type")  # e.g. "v5litepod-16"
+        if accel_type:
+            gen = accel_type.split("-")[0]
+            return _CHIPS_PER_HOST.get(gen, 4)
+        # Pallas/axon tunnel (this dev environment): one remote chip.
+        if os.environ.get("PALLAS_AXON_TPU_GEN"):
+            return 1
+        return 0
+
+    @staticmethod
+    def get_current_pod_type() -> Optional[str]:
+        """e.g. 'v5litepod-16' — the accelerator-type of the slice."""
+        env = os.environ.get("RT_TPU_POD_TYPE")
+        if env:
+            return env
+        accel_type = _metadata("accelerator-type")
+        if accel_type:
+            return accel_type
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+        if gen:
+            return gen
+        return None
+
+    @staticmethod
+    def get_current_topology() -> Optional[str]:
+        env = os.environ.get("RT_TPU_TOPOLOGY")
+        if env:
+            return env
+        return _metadata("tpu-env") and _parse_tpu_env("TOPOLOGY") or None
+
+    @staticmethod
+    def get_current_worker_id() -> Optional[int]:
+        env = os.environ.get("RT_TPU_WORKER_ID")
+        if env is not None:
+            return int(env)
+        wid = _metadata("agent-worker-number")
+        if wid is not None:
+            try:
+                return int(wid)
+            except ValueError:
+                return None
+        return None
+
+    @staticmethod
+    def set_visible_accelerator_ids(ids: List[str]) -> None:
+        os.environ[TPU_VISIBLE_CHIPS_ENV] = ",".join(ids)
+
+    @staticmethod
+    def num_workers_in_slice(pod_type: str) -> int:
+        """Hosts in a slice, from the pod type (e.g. v5litepod-16 → 4 hosts)."""
+        try:
+            gen, chips = pod_type.rsplit("-", 1)
+            per_host = _CHIPS_PER_HOST.get(gen.split("_")[0], 4)
+            return max(1, int(chips) // per_host)
+        except (ValueError, KeyError):
+            return 1
+
+
+def _parse_tpu_env(key: str) -> Optional[str]:
+    raw = _metadata("tpu-env")
+    if not raw:
+        return None
+    try:
+        for line in raw.splitlines():
+            if line.startswith(key):
+                return line.split(":", 1)[1].strip().strip("'\"")
+    except Exception:
+        return None
+    return None
+
+
+def get_tpu_coordinator_env_vars(
+    coordinator_address: str, num_slices: int, slice_id: int
+) -> dict:
+    """MEGASCALE env for DCN multislice meshes.
+
+    Parity: ray.util.tpu.get_tpu_coordinator_env_vars (util/tpu.py:198) —
+    the env that makes XLA build a hierarchical ICI(inner)/DCN(outer) mesh.
+    """
+    return {
+        "MEGASCALE_COORDINATOR_ADDRESS": coordinator_address,
+        "MEGASCALE_NUM_SLICES": str(num_slices),
+        "MEGASCALE_SLICE_ID": str(slice_id),
+        "MEGASCALE_PORT": "8081",
+    }

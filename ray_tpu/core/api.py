@@ -1,0 +1,230 @@
+"""Public core API.
+
+Parity: python/ray/_private/worker.py — ray.init :1388, ray.get :2831,
+ray.put :2982, ray.wait :3053, ray.kill :3233, ray.cancel :3277,
+ray.get_actor :3198, @ray.remote :3453.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu.core import worker as worker_mod
+from ray_tpu.core.actor import ActorClass, ActorHandle, make_handle_from_info, method  # noqa: F401
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime_context import RuntimeContext
+from ray_tpu.core.task import RemoteFunction, TaskOptions, _merge_options
+from ray_tpu.utils.config import config
+
+_init_lock = threading.Lock()
+_head_services: Optional[Dict[str, Any]] = None
+
+
+class NodeAffinitySchedulingStrategy:
+    """Parity: ray.util.scheduling_strategies.NodeAffinitySchedulingStrategy :43."""
+
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+def is_initialized() -> bool:
+    return worker_mod.global_worker_or_none() is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    object_store_memory_mb: Optional[int] = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+) -> RuntimeContext:
+    """Start (or connect to) a cluster and attach this process as driver.
+
+    address=None starts a head in-process: control store + node agent run as
+    threads here (reference: ray.init starting gcs_server + raylet,
+    SURVEY.md §3.1); worker processes are spawned on demand.
+    address="host:port" connects to an existing control store.
+    """
+    global _head_services
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return get_runtime_context()
+            raise RuntimeError("ray_tpu.init() called twice; call shutdown() first")
+
+        if object_store_memory_mb is not None:
+            config.set("object_store_memory_mb", object_store_memory_mb)
+
+        if address is None:
+            from ray_tpu.core.control_store import ControlStore
+            from ray_tpu.core.node_agent import NodeAgent
+
+            session_id = uuid.uuid4().hex
+            control = ControlStore(session_id)
+            control.start()
+            res_override: Dict[str, float] = dict(resources or {})
+            if num_cpus is not None:
+                res_override["CPU"] = float(num_cpus)
+            if num_tpus is not None:
+                res_override["TPU"] = float(num_tpus)
+            agent = NodeAgent(
+                control.address, session_id,
+                resources=res_override or None, labels=labels,
+            )
+            agent.start()
+            _head_services = {"control": control, "agent": agent}
+            control_address = control.address
+            agent_address = agent.address
+            node_id_hex = agent.node_id.hex()
+        else:
+            control_address = address
+            # pick an agent on this cluster to act as our local object/lease
+            # endpoint (the driver host's own agent in a real deployment)
+            from ray_tpu.utils.rpc import RpcClient
+
+            probe = RpcClient(control_address, name="probe")
+            nodes = probe.call("get_nodes", retryable=True)
+            probe.close()
+            if not nodes:
+                raise RayTpuError(f"no alive nodes at {address}")
+            agent_address = nodes[0]["address"]
+            node_id_hex = nodes[0]["node_id"]
+            session_id = "joined"
+
+        w = worker_mod.CoreWorker(
+            mode="driver",
+            control_address=control_address,
+            node_agent_address=agent_address,
+            session_id=session_id,
+            node_id_hex=node_id_hex,
+        )
+        w.namespace = namespace
+        w.connect_driver()
+        worker_mod.set_global_worker(w)
+        return RuntimeContext(w)
+
+
+def shutdown() -> None:
+    global _head_services
+    with _init_lock:
+        w = worker_mod.global_worker_or_none()
+        if w is not None:
+            try:
+                w.control.call("finish_job", job_id=w.job_id.hex(), timeout_s=10.0)
+            except Exception:  # noqa: BLE001 — control store may be gone
+                pass
+            w.shutdown()
+            worker_mod.set_global_worker(None)
+        if _head_services is not None:
+            _head_services["agent"].stop()
+            _head_services["control"].stop()
+            _head_services = None
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (parity: worker.py:3453)."""
+
+    def decorate(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, options)
+        opts = _merge_options(TaskOptions(), **options)
+        return RemoteFunction(obj, opts)
+
+    if len(args) == 1 and not options and (inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return decorate
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed")
+    return worker_mod.global_worker().put(value)
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+):
+    w = worker_mod.global_worker()
+    single = isinstance(refs, ObjectRef)
+    ref_list = [refs] if single else list(refs)
+    for r in ref_list:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRefs, got {type(r)}")
+    values = w.get(ref_list, timeout_s=timeout)
+    return values[0] if single else values
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() got duplicate ObjectRefs")
+    if num_returns <= 0 or num_returns > len(refs):
+        raise ValueError(f"num_returns must be in [1, {len(refs)}]")
+    return worker_mod.global_worker().wait(
+        refs, num_returns=num_returns, timeout_s=timeout, fetch_local=fetch_local
+    )
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    worker_mod.global_worker().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    worker_mod.global_worker().cancel_task(ref)
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    w = worker_mod.global_worker()
+    info = w.control.call("get_named_actor", name=name, namespace=namespace)
+    if info is None:
+        raise ValueError(f"no actor named {name!r} in namespace {namespace!r}")
+    return make_handle_from_info(info)
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(worker_mod.global_worker())
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return worker_mod.global_worker().control.call("get_nodes")
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    w = worker_mod.global_worker()
+    view = w.control.call("get_cluster_view")
+    total: Dict[str, float] = {}
+    for n in view.values():
+        for k, v in n["resources_available"].items():
+            total[k] = total.get(k, 0.0) + v
+    return total

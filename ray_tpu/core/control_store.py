@@ -1,0 +1,689 @@
+"""Control store — the cluster control plane (GCS equivalent).
+
+Parity: the reference GCS server (src/ray/gcs/gcs_server.h:96) and its
+managers: node membership + health checks (GcsNodeManager,
+gcs_health_check_manager.h:45), actor directory + FT scheduling
+(GcsActorManager src/ray/gcs/actor/gcs_actor_manager.h:93, restart logic
+gcs_actor_manager.cc:1477-1506), placement groups with 2-phase commit
+(GcsPlacementGroupManager gcs_placement_group_manager.h:50, PREPARE/COMMIT
+gcs_placement_group_scheduler.h:115-117), jobs (GcsJobManager), KV store
+(store_client.h — in-memory here, pluggable), pubsub (src/ray/pubsub/), and
+the resource-view syncer (src/ray/ray_syncer/ray_syncer.h:91 — here:
+heartbeat-carried resource reports fanned out on a pubsub topic).
+
+Runs as threads inside the head process; all state in-memory (a persistence
+hook mirrors the Redis-backed FT mode and can be added behind StoreBackend).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import scheduling
+from ray_tpu.utils.config import config
+from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu.utils.rpc import ClientPool, RpcError, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class ActorState:
+    DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+    PENDING_CREATION = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class PGState:
+    PENDING = "PENDING"
+    CREATED = "CREATED"
+    REMOVED = "REMOVED"
+    RESCHEDULING = "RESCHEDULING"
+
+
+class ControlStore:
+    def __init__(self, session_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.session_id = session_id
+        self._server = RpcServer("control_store", host, port)
+        self._server.register_instance(self)
+        self._server.on_disconnect = self._handle_disconnect
+
+        self._lock = threading.RLock()
+        self._kv: Dict[str, Dict[str, bytes]] = {}
+        self._nodes: Dict[str, Dict[str, Any]] = {}  # node_id hex -> record
+        self._actors: Dict[str, Dict[str, Any]] = {}  # actor_id hex -> record
+        self._named_actors: Dict[Tuple[str, str], str] = {}
+        self._pgs: Dict[str, Dict[str, Any]] = {}
+        self._jobs: Dict[str, Dict[str, Any]] = {}
+        self._next_job = 1
+
+        # pubsub: topic -> {conn_id: conn}
+        self._subs: Dict[str, Dict[int, Any]] = {}
+
+        self._agents = ClientPool("cs->agent")
+        self._workers = ClientPool("cs->worker")
+        self._stopped = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._server.start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="cs-health", daemon=True
+        )
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._server.stop()
+        self._agents.close_all()
+        self._workers.close_all()
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    # ------------------------------------------------------------------
+    # pubsub (reference C16)
+    # ------------------------------------------------------------------
+
+    def rpc_subscribe(self, conn, topics: List[str]):
+        with self._lock:
+            for t in topics:
+                self._subs.setdefault(t, {})[id(conn)] = conn
+        return True
+
+    def rpc_publish(self, conn, topic: str, payload: Any):
+        self.publish(topic, payload)
+        return True
+
+    def publish(self, topic: str, payload: Any) -> None:
+        with self._lock:
+            conns = list(self._subs.get(topic, {}).values())
+        for c in conns:
+            if not c.push("pubsub", (topic, payload)):
+                with self._lock:
+                    self._subs.get(topic, {}).pop(id(c), None)
+
+    def _handle_disconnect(self, conn) -> None:
+        with self._lock:
+            for subs in self._subs.values():
+                subs.pop(id(conn), None)
+
+    # ------------------------------------------------------------------
+    # KV (reference C14 / internal KV)
+    # ------------------------------------------------------------------
+
+    def rpc_kv_put(self, conn, ns: str, key: str, value: bytes, overwrite: bool = True):
+        with self._lock:
+            table = self._kv.setdefault(ns, {})
+            if not overwrite and key in table:
+                return False
+            table[key] = value
+            return True
+
+    def rpc_kv_get(self, conn, ns: str, key: str):
+        with self._lock:
+            return self._kv.get(ns, {}).get(key)
+
+    def rpc_kv_del(self, conn, ns: str, key: str):
+        with self._lock:
+            return self._kv.get(ns, {}).pop(key, None) is not None
+
+    def rpc_kv_keys(self, conn, ns: str, prefix: str = ""):
+        with self._lock:
+            return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # nodes (reference GcsNodeManager + health checks + syncer)
+    # ------------------------------------------------------------------
+
+    def rpc_register_node(self, conn, node_info: Dict[str, Any]):
+        node_id = node_info["node_id"]
+        with self._lock:
+            self._nodes[node_id] = {
+                **node_info,
+                "alive": True,
+                "last_heartbeat": time.monotonic(),
+                "resources_available": dict(node_info["resources_total"]),
+            }
+        logger.info("node %s registered at %s", node_id[:8], node_info["address"])
+        self.publish("node", {"event": "added", "node": self._public_node(node_id)})
+        return {"config_snapshot": config.snapshot(), "session_id": self.session_id}
+
+    def rpc_heartbeat(self, conn, node_id: str, resources_available: Dict[str, float],
+                      extra: Optional[Dict[str, Any]] = None):
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node["alive"]:
+                return {"ok": False}  # tells a zombie agent to exit
+            node["last_heartbeat"] = time.monotonic()
+            node["resources_available"] = resources_available
+            if extra:
+                node.update(extra)
+        return {"ok": True}
+
+    def rpc_get_nodes(self, conn, alive_only: bool = True):
+        with self._lock:
+            return [
+                self._public_node(nid)
+                for nid, n in self._nodes.items()
+                if n["alive"] or not alive_only
+            ]
+
+    def rpc_get_cluster_view(self, conn):
+        """Scheduling view: per-node totals/availables (syncer equivalent)."""
+        with self._lock:
+            return self._cluster_view_locked()
+
+    def rpc_drain_node(self, conn, node_id: str):
+        self._mark_node_dead(node_id, "drained")
+        return True
+
+    def _public_node(self, node_id: str) -> Dict[str, Any]:
+        n = self._nodes[node_id]
+        return {
+            "node_id": node_id,
+            "address": n["address"],
+            "resources_total": n["resources_total"],
+            "labels": n.get("labels", {}),
+            "alive": n["alive"],
+        }
+
+    def _health_loop(self) -> None:
+        while not self._stopped.wait(config.health_check_period_s):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for nid, n in self._nodes.items():
+                    if n["alive"] and now - n["last_heartbeat"] > config.health_check_timeout_s:
+                        dead.append(nid)
+            for nid in dead:
+                logger.warning("node %s missed heartbeats; marking dead", nid[:8])
+                self._mark_node_dead(nid, "heartbeat timeout")
+
+    def _mark_node_dead(self, node_id: str, reason: str) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node["alive"]:
+                return
+            node["alive"] = False
+            affected_actors = [
+                a for a in self._actors.values()
+                if a.get("node_id") == node_id
+                and a["state"] in (ActorState.ALIVE, ActorState.PENDING_CREATION)
+            ]
+        self.publish("node", {"event": "removed", "node_id": node_id, "reason": reason})
+        for actor in affected_actors:
+            self._on_actor_worker_lost(actor["actor_id"], f"node died: {reason}")
+
+    # ------------------------------------------------------------------
+    # jobs
+    # ------------------------------------------------------------------
+
+    def rpc_register_job(self, conn, driver_address: str, metadata: Dict[str, Any]):
+        with self._lock:
+            job_id = JobID.from_int(self._next_job)
+            self._next_job += 1
+            self._jobs[job_id.hex()] = {
+                "job_id": job_id.hex(),
+                "driver_address": driver_address,
+                "metadata": metadata,
+                "start_time": time.time(),
+                "alive": True,
+            }
+        return job_id.hex()
+
+    def rpc_finish_job(self, conn, job_id: str):
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job:
+                job["alive"] = False
+                job["end_time"] = time.time()
+        # Non-detached actors owned by the job die with it.
+        with self._lock:
+            doomed = [
+                a["actor_id"] for a in self._actors.values()
+                if a.get("job_id") == job_id
+                and a.get("lifetime") != "detached"
+                and a["state"] not in (ActorState.DEAD,)
+            ]
+        for aid in doomed:
+            self._kill_actor_internal(aid, "job finished", no_restart=True)
+        return True
+
+    def rpc_list_jobs(self, conn):
+        with self._lock:
+            return list(self._jobs.values())
+
+    # ------------------------------------------------------------------
+    # actors (reference C2: GcsActorManager + GcsActorScheduler)
+    # ------------------------------------------------------------------
+
+    def rpc_register_actor(self, conn, spec: Dict[str, Any]):
+        """Register + asynchronously schedule an actor.
+
+        spec: actor_id, job_id, class_blob_key, init args (by value or refs),
+        resources, name/namespace, lifetime, max_restarts, max_concurrency,
+        scheduling_strategy, owner_address.
+        """
+        actor_id = spec["actor_id"]
+        name = spec.get("name")
+        ns = spec.get("namespace", "default")
+        with self._lock:
+            if name:
+                key = (ns, name)
+                if key in self._named_actors:
+                    existing = self._named_actors[key]
+                    if self._actors[existing]["state"] != ActorState.DEAD:
+                        raise ValueError(
+                            f"actor name {name!r} already taken in namespace {ns!r}"
+                        )
+                self._named_actors[key] = actor_id
+            record = {
+                **spec,
+                "state": ActorState.PENDING_CREATION,
+                "num_restarts": 0,
+                "node_id": None,
+                "worker_address": None,
+                "death_cause": None,
+            }
+            self._actors[actor_id] = record
+        threading.Thread(
+            target=self._schedule_actor, args=(actor_id,),
+            name=f"cs-sched-actor-{actor_id[:8]}", daemon=True,
+        ).start()
+        return True
+
+    def _schedule_actor(self, actor_id: str) -> None:
+        backoff = 0.05
+        while not self._stopped.is_set():
+            with self._lock:
+                record = self._actors.get(actor_id)
+                if record is None or record["state"] in (ActorState.DEAD, ActorState.ALIVE):
+                    return
+                view = self._cluster_view_locked()
+                strategy = record.get("scheduling_strategy")
+                resources = record.get("resources", {})
+            node_id = scheduling.pick_node(view, resources, strategy, self._pgs, self._lock)
+            if node_id is None:
+                time.sleep(min(backoff, 1.0))
+                backoff *= 2
+                continue
+            agent_addr = view[node_id]["address"]
+            try:
+                lease = self._agents.get(agent_addr).call(
+                    "lease_worker",
+                    resources=resources,
+                    bundle=scheduling.pg_bundle_of(record.get("scheduling_strategy")),
+                    wait_s=config.worker_register_timeout_s,
+                    timeout_s=config.worker_register_timeout_s + 15,
+                )
+            except RpcError as e:
+                logger.warning("actor %s lease on %s failed: %s", actor_id[:8], node_id[:8], e)
+                time.sleep(min(backoff, 1.0))
+                backoff *= 2
+                continue
+            if not lease.get("granted"):
+                time.sleep(min(backoff, 1.0))
+                backoff *= 2
+                continue
+            worker_addr = lease["worker_address"]
+            with self._lock:
+                record = self._actors.get(actor_id)
+                if record is None or record["state"] == ActorState.DEAD:
+                    # killed while scheduling; return the lease
+                    try:
+                        self._agents.get(agent_addr).call_oneway(
+                            "release_worker", lease_id=lease["lease_id"], kill=False
+                        )
+                    except RpcError:
+                        pass
+                    return
+                spec = dict(record)
+            try:
+                created = self._workers.get(worker_addr).call(
+                    "create_actor", spec=spec,
+                    timeout_s=config.rpc_request_timeout_s,
+                )
+            except RpcError as e:
+                # transport failure: worker unusable, retry elsewhere
+                logger.warning("actor %s creation on %s failed: %s", actor_id[:8], worker_addr, e)
+                try:
+                    self._agents.get(agent_addr).call_oneway(
+                        "release_worker", lease_id=lease["lease_id"], kill=True
+                    )
+                except RpcError:
+                    pass
+                time.sleep(min(backoff, 1.0))
+                backoff *= 2
+                continue
+            if not created.get("ok"):
+                # __init__ raised: permanent, surface the error to callers
+                try:
+                    self._agents.get(agent_addr).call_oneway(
+                        "release_worker", lease_id=lease["lease_id"], kill=True
+                    )
+                except RpcError:
+                    pass
+                with self._lock:
+                    record = self._actors.get(actor_id)
+                    if record is not None:
+                        record["state"] = ActorState.DEAD
+                        record["death_cause"] = str(created.get("error"))
+                self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
+                self.publish("actor", self._public_actor(actor_id))
+                return
+            with self._lock:
+                record = self._actors.get(actor_id)
+                if record is None:
+                    return
+                record["state"] = ActorState.ALIVE
+                record["node_id"] = node_id
+                record["worker_address"] = worker_addr
+                record["lease_id"] = lease["lease_id"]
+                record["agent_address"] = agent_addr
+            self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
+            self.publish("actor", self._public_actor(actor_id))
+            return
+
+    def rpc_get_actor_info(self, conn, actor_id: str):
+        with self._lock:
+            if actor_id not in self._actors:
+                return None
+            return self._public_actor(actor_id)
+
+    def rpc_wait_actor_alive(self, conn, actor_id: str, wait_s: float = 60.0):
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                record = self._actors.get(actor_id)
+                if record is None:
+                    return None
+                if record["state"] in (ActorState.ALIVE, ActorState.DEAD):
+                    return self._public_actor(actor_id)
+            time.sleep(0.02)
+        with self._lock:
+            return self._public_actor(actor_id) if actor_id in self._actors else None
+
+    def rpc_get_named_actor(self, conn, name: str, namespace: str = "default"):
+        with self._lock:
+            actor_id = self._named_actors.get((namespace, name))
+            if actor_id is None:
+                return None
+            record = self._actors.get(actor_id)
+            if record is None or record["state"] == ActorState.DEAD:
+                return None
+            return self._public_actor(actor_id)
+
+    def rpc_list_actors(self, conn):
+        with self._lock:
+            return [self._public_actor(aid) for aid in self._actors]
+
+    def rpc_report_actor_death(self, conn, actor_id: str, reason: str,
+                               expected: bool = False):
+        """Called by agents/workers when an actor's worker process exits."""
+        if expected:
+            self._kill_actor_internal(actor_id, reason, no_restart=True)
+        else:
+            self._on_actor_worker_lost(actor_id, reason)
+        return True
+
+    def rpc_report_worker_failure(self, conn, worker_address: str, node_id: str,
+                                  reason: str):
+        """A worker process died; fail over any actor it hosted."""
+        with self._lock:
+            affected = [
+                a["actor_id"] for a in self._actors.values()
+                if a.get("worker_address") == worker_address
+                and a["state"] in (ActorState.ALIVE, ActorState.PENDING_CREATION)
+            ]
+        self._workers.drop(worker_address)
+        for actor_id in affected:
+            self._on_actor_worker_lost(actor_id, reason)
+        self.publish("worker", {"event": "died", "worker_address": worker_address,
+                                "node_id": node_id, "reason": reason})
+        return True
+
+    def rpc_kill_actor(self, conn, actor_id: str, no_restart: bool = True):
+        self._kill_actor_internal(actor_id, "ray_tpu.kill", no_restart=no_restart)
+        return True
+
+    def rpc_actor_handle_dropped(self, conn, actor_id: str):
+        """The original handle went out of scope: GC the actor unless it is
+        detached (parity: GcsActorManager handle-count GC)."""
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None or record.get("lifetime") == "detached":
+                return False
+        self._kill_actor_internal(
+            actor_id, "all handles to the actor went out of scope",
+            no_restart=True,
+        )
+        return True
+
+    def _kill_actor_internal(self, actor_id: str, reason: str, no_restart: bool) -> None:
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None or record["state"] == ActorState.DEAD:
+                return
+            worker_addr = record.get("worker_address")
+            agent_addr = record.get("agent_address")
+            lease_id = record.get("lease_id")
+            if no_restart:
+                record["state"] = ActorState.DEAD
+                record["death_cause"] = reason
+        if worker_addr:
+            try:
+                self._workers.get(worker_addr).call_oneway("exit_worker")
+            except RpcError:
+                pass
+            self._workers.drop(worker_addr)
+        if agent_addr and lease_id:
+            try:
+                self._agents.get(agent_addr).call_oneway(
+                    "release_worker", lease_id=lease_id, kill=True
+                )
+            except RpcError:
+                pass
+        if no_restart:
+            self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
+            self.publish("actor", self._public_actor(actor_id))
+        else:
+            self._on_actor_worker_lost(actor_id, reason)
+
+    def _on_actor_worker_lost(self, actor_id: str, reason: str) -> None:
+        """Restart-or-die decision (reference gcs_actor_manager.cc:1477)."""
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None or record["state"] == ActorState.DEAD:
+                return
+            max_restarts = record.get("max_restarts", 0)
+            if max_restarts == -1 or record["num_restarts"] < max_restarts:
+                record["num_restarts"] += 1
+                record["state"] = ActorState.RESTARTING
+                record["worker_address"] = None
+                record["node_id"] = None
+                restart = True
+            else:
+                record["state"] = ActorState.DEAD
+                record["death_cause"] = reason
+                restart = False
+        self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
+        self.publish("actor", self._public_actor(actor_id))
+        if restart:
+            threading.Thread(
+                target=self._schedule_actor, args=(actor_id,),
+                name=f"cs-resched-actor-{actor_id[:8]}", daemon=True,
+            ).start()
+
+    def _public_actor(self, actor_id: str) -> Dict[str, Any]:
+        r = self._actors[actor_id]
+        return {
+            "actor_id": actor_id,
+            "state": r["state"],
+            "node_id": r.get("node_id"),
+            "worker_address": r.get("worker_address"),
+            "name": r.get("name"),
+            "namespace": r.get("namespace", "default"),
+            "class_name": r.get("class_name"),
+            "method_names": r.get("method_names", []),
+            "num_restarts": r.get("num_restarts", 0),
+            "max_restarts": r.get("max_restarts", 0),
+            "death_cause": r.get("death_cause"),
+            "job_id": r.get("job_id"),
+            "lifetime": r.get("lifetime"),
+        }
+
+    # ------------------------------------------------------------------
+    # placement groups (reference C3: 2PC prepare/commit)
+    # ------------------------------------------------------------------
+
+    def rpc_create_placement_group(self, conn, pg_id: str, bundles: List[Dict[str, float]],
+                                   strategy: str, name: Optional[str] = None,
+                                   job_id: Optional[str] = None):
+        with self._lock:
+            self._pgs[pg_id] = {
+                "pg_id": pg_id,
+                "bundles": bundles,
+                "strategy": strategy,
+                "name": name,
+                "job_id": job_id,
+                "state": PGState.PENDING,
+                # bundle index -> node_id hex
+                "bundle_locations": {},
+            }
+        threading.Thread(
+            target=self._schedule_pg, args=(pg_id,),
+            name=f"cs-sched-pg-{pg_id[:8]}", daemon=True,
+        ).start()
+        return True
+
+    def _schedule_pg(self, pg_id: str) -> None:
+        backoff = 0.05
+        while not self._stopped.is_set():
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None or pg["state"] in (PGState.CREATED, PGState.REMOVED):
+                    return
+                bundles = pg["bundles"]
+                strategy = pg["strategy"]
+                view = self._cluster_view_locked()
+            placement = scheduling.place_bundles(view, bundles, strategy)
+            if placement is None:
+                time.sleep(min(backoff, 1.0))
+                backoff = min(backoff * 2, 1.0)
+                continue
+            # Phase 1: PREPARE on every involved agent.
+            by_node: Dict[str, List[int]] = {}
+            for idx, node_id in placement.items():
+                by_node.setdefault(node_id, []).append(idx)
+            prepared: List[Tuple[str, List[int]]] = []
+            ok = True
+            for node_id, idxs in by_node.items():
+                addr = view[node_id]["address"]
+                try:
+                    res = self._agents.get(addr).call(
+                        "prepare_bundles", pg_id=pg_id,
+                        bundles={i: bundles[i] for i in idxs},
+                    )
+                except RpcError:
+                    res = False
+                if res:
+                    prepared.append((node_id, idxs))
+                else:
+                    ok = False
+                    break
+            if not ok:
+                # roll back prepared nodes
+                for node_id, idxs in prepared:
+                    try:
+                        self._agents.get(view[node_id]["address"]).call_oneway(
+                            "return_bundles", pg_id=pg_id
+                        )
+                    except RpcError:
+                        pass
+                time.sleep(min(backoff, 1.0))
+                backoff = min(backoff * 2, 1.0)
+                continue
+            # Phase 2: COMMIT.
+            for node_id, idxs in by_node.items():
+                try:
+                    self._agents.get(view[node_id]["address"]).call(
+                        "commit_bundles", pg_id=pg_id
+                    )
+                except RpcError:
+                    logger.warning("pg %s commit failed on %s", pg_id[:8], node_id[:8])
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    return
+                pg["state"] = PGState.CREATED
+                pg["bundle_locations"] = placement
+            self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.CREATED})
+            return
+
+    def rpc_get_placement_group(self, conn, pg_id: str):
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            return dict(pg) if pg else None
+
+    def rpc_wait_placement_group(self, conn, pg_id: str, wait_s: float = 60.0):
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    return None
+                if pg["state"] in (PGState.CREATED, PGState.REMOVED):
+                    return dict(pg)
+            time.sleep(0.02)
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            return dict(pg) if pg else None
+
+    def rpc_remove_placement_group(self, conn, pg_id: str):
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return False
+            pg["state"] = PGState.REMOVED
+            locations = dict(pg["bundle_locations"])
+            view = self._cluster_view_locked()
+        for node_id in set(locations.values()):
+            node = view.get(node_id)
+            if node:
+                try:
+                    self._agents.get(node["address"]).call_oneway(
+                        "return_bundles", pg_id=pg_id
+                    )
+                except RpcError:
+                    pass
+        self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.REMOVED})
+        return True
+
+    def rpc_list_placement_groups(self, conn):
+        with self._lock:
+            return [dict(pg) for pg in self._pgs.values()]
+
+    # ------------------------------------------------------------------
+
+    def _cluster_view_locked(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            nid: {
+                "address": n["address"],
+                "resources_total": n["resources_total"],
+                "resources_available": n["resources_available"],
+                "labels": n.get("labels", {}),
+                "alive": True,
+            }
+            for nid, n in self._nodes.items()
+            if n["alive"]
+        }

@@ -1,0 +1,65 @@
+"""User-visible exceptions (parity: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray_tpu.get().
+
+    Parity: ray.exceptions.RayTaskError — carries the remote traceback.
+    """
+
+    def __init__(self, message: str, remote_traceback: str = "", cause=None):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+        self.cause = cause
+
+    def __str__(self):
+        base = super().__str__()
+        if self.remote_traceback:
+            return f"{base}\n\n--- remote traceback ---\n{self.remote_traceback}"
+        return base
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker executing the task died unexpectedly."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead; no more method calls will succeed."""
+
+    def __init__(self, message: str = "The actor died.", actor_id=None):
+        super().__init__(message)
+        self.actor_id = actor_id
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object value was lost and could not be reconstructed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get() timed out."""
+
+
+class TaskCancelledError(RayTpuError):
+    """Task was cancelled before or during execution."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up the runtime environment for a task/actor."""
+
+
+class NodeDiedError(RayTpuError):
+    """A node was lost while work depended on it."""
+
+
+class PlacementGroupError(RayTpuError):
+    """Placement group creation/usage error."""

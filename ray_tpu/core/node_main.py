@@ -1,0 +1,64 @@
+"""Standalone node agent entrypoint — a non-head node joining a cluster.
+
+Parity: the raylet binary (src/ray/raylet/main.cc). Used by
+cluster_utils.Cluster to build multi-node topologies on one machine
+(reference linchpin: python/ray/cluster_utils.py:135) and by `rt start`
+for real multi-host deployments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--control-address", required=True)
+    parser.add_argument("--session-id", required=True)
+    parser.add_argument("--resources", default="{}", help="JSON resource overrides")
+    parser.add_argument("--labels", default="{}", help="JSON node labels")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[node_agent {os.getpid()}] %(levelname)s %(name)s: %(message)s",
+    )
+
+    from ray_tpu.utils.config import config
+
+    snapshot = os.environ.get("RT_CONFIG_SNAPSHOT")
+    if snapshot:
+        config.load_snapshot(snapshot)
+
+    from ray_tpu.core.node_agent import NodeAgent
+
+    agent = NodeAgent(
+        args.control_address,
+        args.session_id,
+        resources=json.loads(args.resources) or None,
+        labels=json.loads(args.labels) or None,
+    )
+    agent.standalone = True
+    agent.start()
+    print(json.dumps({"node_id": agent.node_id.hex(), "address": agent.address}),
+          flush=True)
+
+    stop = {"flag": False}
+
+    def handle(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    while not stop["flag"]:
+        time.sleep(0.2)
+    agent.stop()
+
+
+if __name__ == "__main__":
+    main()

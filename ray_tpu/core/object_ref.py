@@ -1,0 +1,113 @@
+"""ObjectRef: a first-class future naming an object owned by some worker.
+
+Parity: ray.ObjectRef (python/ray/includes/object_ref.pxi). The ref carries
+its owner's address so any holder can locate the value without a directory
+lookup — the ownership model of the reference (src/ray/core_worker/
+reference_counter.h:44). Refs are pickleable; deserializing one in another
+process registers a borrow with the owner (round-1: release on driver GC).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.utils.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_weak")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = "", weak: bool = False):
+        self.id = object_id
+        self.owner_address = owner_address
+        # weak refs don't participate in refcounting (internal bookkeeping)
+        self._weak = weak
+        if not weak:
+            _get_tracker().add_local_ref(self)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def job_id(self):
+        return self.id.job_id()
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        # Crossing a process boundary: pin the object on the owner side (it
+        # may now have remote holders the owner can't see — round-1
+        # borrowing simplification), and make the receiver reconstruct via
+        # _deserialize so borrows are registered.
+        _get_tracker().mark_escaped(self)
+        return (_deserialize_ref, (self.id, self.owner_address))
+
+    def __del__(self):
+        if not self._weak:
+            try:
+                _get_tracker().remove_local_ref(self)
+            except Exception:
+                pass
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+        import threading
+
+        from ray_tpu.core import api
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def wait_thread():
+            try:
+                fut.set_result(api.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=wait_thread, daemon=True).start()
+        return fut
+
+
+def _deserialize_ref(object_id: ObjectID, owner_address: str) -> ObjectRef:
+    ref = ObjectRef(object_id, owner_address, weak=True)
+    _get_tracker().add_borrowed_ref(ref)
+    return ref
+
+
+class _NullTracker:
+    def add_local_ref(self, ref):
+        pass
+
+    def remove_local_ref(self, ref):
+        pass
+
+    def add_borrowed_ref(self, ref):
+        pass
+
+    def mark_escaped(self, ref):
+        pass
+
+
+_null_tracker = _NullTracker()
+
+
+def _get_tracker():
+    """The current process's reference tracker (CoreWorker), if connected."""
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker_or_none()
+    if w is None:
+        return _null_tracker
+    return w.reference_tracker

@@ -1,0 +1,259 @@
+"""Shared-memory object store (plasma equivalent) + in-process memory store.
+
+Parity targets: the plasma store (reference
+src/ray/object_manager/plasma/store.h:55 — per-node shared-memory immutable
+objects, clients mmap, zero-copy reads) and the CoreWorker in-process memory
+store for small objects (src/ray/core_worker/store_provider/memory_store/).
+
+TPU-first design notes: objects are single contiguous frames
+(serialization.pack) so Arrow batches / numpy arrays deserialize as
+zero-copy views onto the mapping — the property that lets a host feed
+`jax.device_put` without an extra copy. Backing is a file in /dev/shm
+(tmpfs) rather than the multiprocessing.shared_memory module, which would
+fight the resource tracker across our process tree.
+
+The store bookkeeping lives in the node agent process; workers create/seal
+via agent RPC and mmap the segment directly (fd-passing equivalent of
+plasma's fling.cc is unnecessary since tmpfs paths are shared on-host).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core.exceptions import ObjectLostError
+from ray_tpu.utils.ids import ObjectID
+
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+
+
+class ShmObjectStore:
+    """Per-node store bookkeeping: create/seal/get-meta/delete segments."""
+
+    def __init__(self, session_id: str, node_id_hex: str, capacity_bytes: int):
+        self._prefix = os.path.join(
+            _SHM_DIR, f"rtshm_{session_id[:8]}_{node_id_hex[:8]}"
+        )
+        self._capacity = capacity_bytes
+        self._used = 0
+        self._lock = threading.Lock()
+        self._sealed_cv = threading.Condition(self._lock)
+        # oid hex -> (path, size, sealed)
+        self._objects: Dict[str, Tuple[str, int, bool]] = {}
+
+    def create(self, oid_hex: str, size: int) -> str:
+        path = f"{self._prefix}_{oid_hex[:24]}"
+        with self._lock:
+            if oid_hex in self._objects:
+                raise ValueError(f"object {oid_hex} already exists")
+            if self._used + size > self._capacity:
+                raise MemoryError(
+                    f"object store over capacity: used={self._used} "
+                    f"request={size} cap={self._capacity}"
+                )
+            self._used += size
+            self._objects[oid_hex] = (path, size, False)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, max(size, 1))
+        finally:
+            os.close(fd)
+        return path
+
+    def seal(self, oid_hex: str) -> None:
+        with self._lock:
+            entry = self._objects.get(oid_hex)
+            if entry is None:
+                raise KeyError(oid_hex)
+            self._objects[oid_hex] = (entry[0], entry[1], True)
+            self._sealed_cv.notify_all()
+
+    def get_meta(
+        self, oid_hex: str, timeout_s: Optional[float] = None
+    ) -> Optional[Tuple[str, int]]:
+        """Block until sealed (or timeout); return (path, size) or None."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                entry = self._objects.get(oid_hex)
+                if entry is not None and entry[2]:
+                    return entry[0], entry[1]
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._sealed_cv.wait(remaining)
+                else:
+                    self._sealed_cv.wait(1.0)
+
+    def contains(self, oid_hex: str) -> bool:
+        with self._lock:
+            entry = self._objects.get(oid_hex)
+            return entry is not None and entry[2]
+
+    def delete(self, oid_hex: str) -> None:
+        with self._lock:
+            entry = self._objects.pop(oid_hex, None)
+            if entry is None:
+                return
+            self._used -= entry[1]
+        try:
+            os.unlink(entry[0])
+        except OSError:
+            pass
+
+    def usage(self) -> Tuple[int, int]:
+        with self._lock:
+            return self._used, self._capacity
+
+    def shutdown(self) -> None:
+        with self._lock:
+            entries = list(self._objects.values())
+            self._objects.clear()
+            self._used = 0
+        for path, _, _ in entries:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+class ShmClient:
+    """Worker-side zero-copy access to shm segments by path."""
+
+    def __init__(self):
+        self._maps: Dict[str, mmap.mmap] = {}
+        self._lock = threading.Lock()
+
+    def write(self, path: str, frame: bytes) -> None:
+        fd = os.open(path, os.O_RDWR)
+        try:
+            with mmap.mmap(fd, len(frame)) as m:
+                m[: len(frame)] = frame
+        finally:
+            os.close(fd)
+
+    def read_view(self, path: str, size: int) -> memoryview:
+        """mmap the segment (cached) and return a zero-copy view."""
+        with self._lock:
+            m = self._maps.get(path)
+            if m is None:
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+                finally:
+                    os.close(fd)
+                self._maps[path] = m
+        return memoryview(m)[:size]
+
+    def drop(self, path: str) -> None:
+        with self._lock:
+            m = self._maps.pop(path, None)
+        if m is not None:
+            try:
+                m.close()
+            except (BufferError, ValueError):
+                # Live numpy views still reference the mapping; leave it to GC.
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            maps = list(self._maps.values())
+            self._maps.clear()
+        for m in maps:
+            try:
+                m.close()
+            except (BufferError, ValueError):
+                pass
+
+
+class MemoryStore:
+    """In-process store for small objects + error markers.
+
+    Values are stored as Python objects (already deserialized on the owner)
+    or packed frames (when received from executors).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._values: Dict[ObjectID, Any] = {}
+
+    def put(self, oid: ObjectID, value: Any) -> None:
+        with self._lock:
+            self._values[oid] = value
+            self._cv.notify_all()
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._values
+
+    def get(self, oid: ObjectID, timeout_s: Optional[float] = None) -> Any:
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._lock:
+            while oid not in self._values:
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(f"object {oid.hex()} not available")
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(1.0)
+            return self._values[oid]
+
+    def try_get(self, oid: ObjectID):
+        with self._lock:
+            return self._values.get(oid, _MISSING)
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._values.pop(oid, None)
+
+    def keys(self):
+        with self._lock:
+            return list(self._values.keys())
+
+
+class _Missing:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<missing>"
+
+
+_MISSING = _Missing()
+
+
+def is_missing(x) -> bool:
+    return x is _MISSING
+
+
+class PlasmaValue:
+    """Marker stored in a memory store meaning 'value lives in shm'.
+
+    Carries the hosting node agent's address so any process can free the
+    segment (same-host mmap covers reads; cross-host pull is the object
+    manager's job in a later layer)."""
+
+    __slots__ = ("path", "size", "agent_address")
+
+    def __init__(self, path: str, size: int, agent_address: str):
+        self.path = path
+        self.size = size
+        self.agent_address = agent_address
+
+
+class LostValue:
+    """Marker meaning the value is unrecoverable (node death, eviction)."""
+
+    __slots__ = ("message",)
+
+    def __init__(self, message: str):
+        self.message = message
+
+    def raise_(self):
+        raise ObjectLostError(self.message)

@@ -1,0 +1,136 @@
+"""Placement groups — gang reservation of resource bundles.
+
+Parity: python/ray/util/placement_group.py:126 (placement_group API) and
+python/ray/util/scheduling_strategies.py:17 (PlacementGroupSchedulingStrategy).
+The 2PC reservation itself lives in the control store
+(control_store._schedule_pg) and node agents (prepare/commit bundles),
+mirroring gcs_placement_group_scheduler.h:115-117.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.exceptions import PlacementGroupError
+from ray_tpu.utils import serialization
+from ray_tpu.utils.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]], strategy: str):
+        self.id_hex = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundles)
+
+    def ready(self):
+        """Return an ObjectRef that resolves to this PG once created
+        (parity: pg.ready() usable with ray.get)."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.core.object_ref import ObjectRef
+        from ray_tpu.utils.ids import ObjectID, TaskID
+
+        w = worker_mod.global_worker()
+        task_id = TaskID.for_normal_task(w.current_job_id())
+        oid = ObjectID.from_task(task_id, 0)
+        ref = ObjectRef(oid, w.address)
+
+        def waiter():
+            info = w.control.call(
+                "wait_placement_group", pg_id=self.id_hex,
+                wait_s=3600.0, timeout_s=3700.0,
+            )
+            if info and info.get("state") == "CREATED":
+                w.memory_store.put(oid, serialization.pack(self))
+            else:
+                w.memory_store.put(
+                    oid,
+                    PlacementGroupError(
+                        f"placement group {self.id_hex[:8]} not created: "
+                        f"{info.get('state') if info else 'missing'}"
+                    ),
+                )
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return ref
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        from ray_tpu.core import worker as worker_mod
+
+        w = worker_mod.global_worker()
+        info = w.control.call(
+            "wait_placement_group", pg_id=self.id_hex, wait_s=timeout_seconds,
+            timeout_s=timeout_seconds + 30.0,
+        )
+        return bool(info and info.get("state") == "CREATED")
+
+    def table(self) -> Optional[Dict[str, Any]]:
+        from ray_tpu.core import worker as worker_mod
+
+        w = worker_mod.global_worker()
+        return w.control.call("get_placement_group", pg_id=self.id_hex)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id_hex, self.bundles, self.strategy))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: Optional[str] = None,
+) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"invalid strategy {strategy!r}; expected one of {VALID_STRATEGIES}"
+        )
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for b in bundles:
+        if not b or any(v < 0 for v in b.values()):
+            raise ValueError(f"invalid bundle {b!r}")
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    pg_id = PlacementGroupID.from_random().hex()
+    bundles = [{k: float(v) for k, v in b.items()} for b in bundles]
+    w.control.call(
+        "create_placement_group",
+        pg_id=pg_id, bundles=bundles, strategy=strategy, name=name,
+        job_id=w.current_job_id().hex(),
+        retryable=True,
+    )
+    return PlacementGroup(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    w.control.call("remove_placement_group", pg_id=pg.id_hex)
+
+
+def placement_group_table() -> List[Dict[str, Any]]:
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    return w.control.call("list_placement_groups")
+
+
+class PlacementGroupSchedulingStrategy:
+    """Parity: ray.util.scheduling_strategies.PlacementGroupSchedulingStrategy."""
+
+    def __init__(
+        self,
+        placement_group: PlacementGroup,
+        placement_group_bundle_index: int = -1,
+        placement_group_capture_child_tasks: bool = False,
+    ):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = placement_group_capture_child_tasks

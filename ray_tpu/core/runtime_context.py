@@ -1,0 +1,43 @@
+"""Runtime context (parity: ray.get_runtime_context(),
+python/ray/runtime_context.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class RuntimeContext:
+    def __init__(self, worker):
+        self._worker = worker
+
+    def get_job_id(self) -> str:
+        return self._worker.current_job_id().hex()
+
+    def get_task_id(self) -> Optional[str]:
+        tid = self._worker.current_task_id()
+        return tid.hex() if tid else None
+
+    def get_actor_id(self) -> Optional[str]:
+        return self._worker.current_actor_id()
+
+    def get_node_id(self) -> str:
+        return self._worker.node_id_hex
+
+    def get_worker_id(self) -> str:
+        return self._worker.worker_id.hex()
+
+    @property
+    def was_current_actor_restarted(self) -> bool:
+        return False  # filled by actor runtime in a later round
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        return {}
+
+    def get(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.get_job_id(),
+            "task_id": self.get_task_id(),
+            "actor_id": self.get_actor_id(),
+            "node_id": self.get_node_id(),
+            "worker_id": self.get_worker_id(),
+        }

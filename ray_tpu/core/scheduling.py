@@ -1,0 +1,228 @@
+"""Scheduling policies over the cluster resource view.
+
+Parity: the reference's policy suite under src/ray/raylet/scheduling/policy/
+— hybrid (hybrid_scheduling_policy.h:51 — prefer local under a utilization
+threshold, then best-fit by score), spread, node-affinity, and the bundle
+placement policies used by placement groups (PACK/SPREAD/STRICT_PACK/
+STRICT_SPREAD, bundle_scheduling_policy.cc). Policies are pure functions
+over a view {node_id: {resources_total, resources_available, labels,
+address}} so they are unit-testable without any cluster (reference test
+style: src/ray/raylet/scheduling/tests/).
+
+Scheduling strategies (parity: python/ray/util/scheduling_strategies.py):
+  None | "DEFAULT"                              -> hybrid
+  "SPREAD"                                      -> spread
+  {"type": "node_affinity", "node_id", "soft"}  -> NodeAffinitySchedulingStrategy
+  {"type": "placement_group", "pg_id", "bundle_index"}
+                                                -> PlacementGroupSchedulingStrategy
+  {"type": "node_label", "hard": {label: [values]}}
+                                                -> NodeLabelSchedulingStrategy
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+SPREAD_THRESHOLD = 0.5  # utilization above which hybrid stops packing
+
+
+def _fits(resources: Dict[str, float], available: Dict[str, float]) -> bool:
+    return all(available.get(k, 0.0) >= v for k, v in resources.items() if v > 0)
+
+
+def _feasible(resources: Dict[str, float], total: Dict[str, float]) -> bool:
+    return all(total.get(k, 0.0) >= v for k, v in resources.items() if v > 0)
+
+
+def _utilization(node: Dict[str, Any]) -> float:
+    total = node["resources_total"]
+    avail = node["resources_available"]
+    utils = [
+        1.0 - avail.get(k, 0.0) / v for k, v in total.items() if v > 0
+    ]
+    return max(utils) if utils else 0.0
+
+
+def pg_bundle_of(strategy) -> Optional[Tuple[str, Optional[int]]]:
+    if isinstance(strategy, dict) and strategy.get("type") == "placement_group":
+        return strategy["pg_id"], strategy.get("bundle_index")
+    return None
+
+
+def pick_node(
+    view: Dict[str, Dict[str, Any]],
+    resources: Dict[str, float],
+    strategy: Any = None,
+    pgs: Optional[Dict[str, Dict[str, Any]]] = None,
+    pgs_lock=None,
+    local_node_id: Optional[str] = None,
+) -> Optional[str]:
+    """Pick a node for one lease; None if nothing is feasible right now."""
+    if isinstance(strategy, dict):
+        kind = strategy.get("type")
+        if kind == "node_affinity":
+            target = strategy["node_id"]
+            node = view.get(target)
+            if node is not None and _fits(resources, node["resources_available"]):
+                return target
+            if node is not None and _feasible(resources, node["resources_total"]):
+                return target  # queue on the target
+            if strategy.get("soft"):
+                return _hybrid(view, resources, local_node_id)
+            return None
+        if kind == "placement_group":
+            pg_id = strategy["pg_id"]
+            bundle_index = strategy.get("bundle_index")
+            if pgs is None:
+                return None
+            if pgs_lock is not None:
+                with pgs_lock:
+                    pg = pgs.get(pg_id)
+                    locations = dict(pg["bundle_locations"]) if pg else None
+            else:
+                pg = pgs.get(pg_id)
+                locations = dict(pg["bundle_locations"]) if pg else None
+            if not locations:
+                return None
+            if bundle_index is not None and bundle_index >= 0:
+                return locations.get(bundle_index)
+            # any bundle: pick one whose node still fits the request
+            for idx in sorted(locations):
+                node = view.get(locations[idx])
+                if node and _fits(resources, node["resources_available"]):
+                    return locations[idx]
+            first = sorted(locations)[0] if locations else None
+            return locations.get(first) if first is not None else None
+        if kind == "node_label":
+            hard = strategy.get("hard", {})
+            candidates = {
+                nid: n for nid, n in view.items()
+                if all(n.get("labels", {}).get(k) in v for k, v in hard.items())
+            }
+            return _hybrid(candidates, resources, local_node_id)
+    if strategy == "SPREAD":
+        return _spread(view, resources)
+    return _hybrid(view, resources, local_node_id)
+
+
+def _hybrid(
+    view: Dict[str, Dict[str, Any]],
+    resources: Dict[str, float],
+    local_node_id: Optional[str] = None,
+) -> Optional[str]:
+    """Prefer local while below the spread threshold, else best-fit score;
+    fall back to any feasible-by-total node (work will queue there)."""
+    if local_node_id and local_node_id in view:
+        node = view[local_node_id]
+        if (
+            _fits(resources, node["resources_available"])
+            and _utilization(node) < SPREAD_THRESHOLD
+        ):
+            return local_node_id
+    fitting = [
+        (nid, n) for nid, n in view.items()
+        if _fits(resources, n["resources_available"])
+    ]
+    if fitting:
+        # lowest utilization wins; tie-break randomly to avoid herding
+        random.shuffle(fitting)
+        fitting.sort(key=lambda kv: _utilization(kv[1]))
+        return fitting[0][0]
+    feasible = [
+        nid for nid, n in view.items() if _feasible(resources, n["resources_total"])
+    ]
+    if feasible:
+        return random.choice(feasible)
+    return None
+
+
+def _spread(view, resources) -> Optional[str]:
+    fitting = [
+        (nid, n) for nid, n in view.items()
+        if _fits(resources, n["resources_available"])
+    ]
+    if not fitting:
+        return _hybrid(view, resources)
+    random.shuffle(fitting)
+    fitting.sort(key=lambda kv: _utilization(kv[1]))
+    return fitting[0][0]
+
+
+# ---------------------------------------------------------------------------
+# Placement-group bundle placement
+# ---------------------------------------------------------------------------
+
+
+def place_bundles(
+    view: Dict[str, Dict[str, Any]],
+    bundles: List[Dict[str, float]],
+    strategy: str,
+) -> Optional[Dict[int, str]]:
+    """Map bundle index -> node_id, or None if infeasible right now."""
+    nodes = {nid: dict(n, _avail=dict(n["resources_available"])) for nid, n in view.items()}
+
+    def take(node, bundle) -> bool:
+        if not _fits(bundle, node["_avail"]):
+            return False
+        for k, v in bundle.items():
+            node["_avail"][k] = node["_avail"].get(k, 0.0) - v
+        return True
+
+    placement: Dict[int, str] = {}
+    order = sorted(range(len(bundles)), key=lambda i: -sum(bundles[i].values()))
+
+    if strategy in ("STRICT_PACK",):
+        for nid, node in nodes.items():
+            trial = dict(node, _avail=dict(node["_avail"]))
+            if all(take(trial, bundles[i]) for i in order):
+                return {i: nid for i in range(len(bundles))}
+        return None
+
+    if strategy in ("STRICT_SPREAD",):
+        if len(bundles) > len(nodes):
+            return None
+        used = set()
+        for i in order:
+            chosen = None
+            for nid, node in sorted(
+                nodes.items(), key=lambda kv: _utilization(kv[1])
+            ):
+                if nid in used:
+                    continue
+                if take(node, bundles[i]):
+                    chosen = nid
+                    break
+            if chosen is None:
+                return None
+            used.add(chosen)
+            placement[i] = chosen
+        return placement
+
+    if strategy == "SPREAD":
+        node_list = sorted(nodes.items(), key=lambda kv: _utilization(kv[1]))
+        for pos, i in enumerate(order):
+            chosen = None
+            for offset in range(len(node_list)):
+                nid, node = node_list[(pos + offset) % len(node_list)]
+                if take(node, bundles[i]):
+                    chosen = nid
+                    break
+            if chosen is None:
+                return None
+            placement[i] = chosen
+        return placement
+
+    # PACK (default): fill one node before moving to the next.
+    for i in order:
+        chosen = None
+        for nid, node in sorted(
+            nodes.items(), key=lambda kv: _utilization(kv[1]), reverse=True
+        ):
+            if take(node, bundles[i]):
+                chosen = nid
+                break
+        if chosen is None:
+            return None
+        placement[i] = chosen
+    return placement

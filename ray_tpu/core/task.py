@@ -1,0 +1,117 @@
+"""Task specs and the @remote function wrapper.
+
+Parity: TaskSpecification (reference src/ray/common/task/task_spec.h) and
+RemoteFunction (python/ray/remote_function.py:314 ``_remote``). Functions
+are registered once in the control-store KV function table (the reference
+stores them in GCS KV; _raylet.pyx task execution fetches by id) and
+referenced by content hash in specs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.utils import serialization
+
+
+@dataclass
+class TaskOptions:
+    num_returns: int = 1
+    num_cpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: Any = None  # see core.scheduling docstring
+    name: Optional[str] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+
+    def resource_demand(self, default_cpus: float = 1.0) -> Dict[str, float]:
+        demand = dict(self.resources)
+        cpus = self.num_cpus if self.num_cpus is not None else default_cpus
+        if cpus:
+            demand["CPU"] = float(cpus)
+        if self.num_tpus:
+            demand["TPU"] = float(self.num_tpus)
+        return demand
+
+
+def _merge_options(base: TaskOptions, **overrides) -> TaskOptions:
+    merged = TaskOptions(**{**base.__dict__})
+    for k, v in overrides.items():
+        if v is None and k not in ("scheduling_strategy",):
+            continue
+        if k == "num_gpus":  # accept the Ray-ism, map onto TPU chips
+            k = "num_tpus"
+        if not hasattr(merged, k):
+            raise TypeError(f"unknown option {k!r}")
+        setattr(merged, k, v)
+    return merged
+
+
+class RemoteFunction:
+    """Created by @ray_tpu.remote on a function."""
+
+    def __init__(self, fn, options: TaskOptions):
+        self._fn = fn
+        self._options = options
+        self._blob: Optional[bytes] = None
+        self._fn_id: Optional[str] = None
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _function_blob(self) -> tuple:
+        if self._blob is None:
+            blob = serialization.dumps_function(self._fn)
+            fn_id = hashlib.sha1(blob).hexdigest()[:24]
+            self._blob, self._fn_id = blob, fn_id
+        return self._fn_id, self._blob
+
+    def options(self, **kwargs) -> "RemoteFunction":
+        clone = RemoteFunction(self._fn, _merge_options(self._options, **kwargs))
+        clone._blob, clone._fn_id = self._blob, self._fn_id
+        return clone
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core import worker as worker_mod
+
+        w = worker_mod.global_worker()
+        fn_id, blob = self._function_blob()
+        w.register_function(fn_id, blob, self.__name__)
+        refs = w.submit_task(
+            fn_id=fn_id,
+            fn_name=self.__name__,
+            args=args,
+            kwargs=kwargs,
+            options=self._options,
+        )
+        if self._options.num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self.__name__} cannot be called directly; "
+            f"use {self.__name__}.remote()."
+        )
+
+
+@dataclass
+class TaskSpec:
+    """The wire form of one task invocation."""
+
+    task_id: Any  # TaskID
+    fn_id: str
+    fn_name: str
+    args_frame: bytes  # packed (args, kwargs) — ObjectRefs travel as refs
+    num_returns: int
+    owner_address: str
+    resources: Dict[str, float]
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    name: Optional[str] = None
+    # actor fields
+    actor_id: Optional[str] = None
+    method_name: Optional[str] = None

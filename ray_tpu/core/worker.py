@@ -1,0 +1,1056 @@
+"""CoreWorker — the per-process runtime (driver and workers alike).
+
+Parity: the reference CoreWorker (src/ray/core_worker/core_worker.h:167 —
+Put :486, Get :662, Wait :702, CreateActor :884, SubmitActorTask :952), its
+in-process memory store (store_provider/memory_store/), ownership tracking
+(reference_counter.h:44), task submission (normal_task_submitter.h:124,
+actor_task_submitter.h with per-caller ordering) and task execution
+(task_execution/task_receiver.h + ordered actor queues).
+
+Ownership model: the process that creates an object (by put or by task
+submission) owns it — stores the value (or its plasma marker), serves
+get_object to borrowers, and decides deletion. Refs escaping the owner
+process pin the object (round-1 simplification of the borrowing protocol;
+full distributed refcount lands with lineage reconstruction).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+import traceback
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import object_store as os_mod
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import LostValue, MemoryStore, PlasmaValue, ShmClient
+from ray_tpu.core.task import TaskOptions, TaskSpec
+from ray_tpu.utils import serialization
+from ray_tpu.utils.config import config
+from ray_tpu.utils.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from ray_tpu.utils.rpc import (
+    ClientPool,
+    RemoteError,
+    RpcClient,
+    RpcConnectionError,
+    RpcError,
+    RpcServer,
+    RpcTimeout,
+)
+
+logger = logging.getLogger(__name__)
+
+_global_worker: Optional["CoreWorker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu is not initialized; call ray_tpu.init() first."
+        )
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["CoreWorker"]) -> None:
+    global _global_worker
+    with _global_lock:
+        _global_worker = w
+
+
+class ReferenceTracker:
+    """Per-process ref bookkeeping (reference: reference_counter.h:44)."""
+
+    def __init__(self, worker: "CoreWorker"):
+        self._worker = worker
+        self._lock = threading.Lock()
+        self._local_counts: Dict[ObjectID, int] = {}
+        self._escaped: set = set()
+        self._borrows: Dict[ObjectID, int] = {}  # owner side: remote borrowers
+
+    def add_local_ref(self, ref: ObjectRef) -> None:
+        with self._lock:
+            self._local_counts[ref.id] = self._local_counts.get(ref.id, 0) + 1
+
+    def remove_local_ref(self, ref: ObjectRef) -> None:
+        delete = False
+        release_owner = None
+        with self._lock:
+            count = self._local_counts.get(ref.id, 0) - 1
+            if count <= 0:
+                self._local_counts.pop(ref.id, None)
+                if self._worker.owns(ref):
+                    if ref.id not in self._escaped and not self._borrows.get(ref.id):
+                        delete = True
+                else:
+                    release_owner = ref.owner_address
+            else:
+                self._local_counts[ref.id] = count
+        if delete:
+            self._worker.delete_owned_object(ref.id)
+        elif release_owner:
+            self._worker.send_release_borrow(release_owner, ref.id)
+
+    def add_borrowed_ref(self, ref: ObjectRef) -> None:
+        # Count it locally like any ref; notify the owner once.
+        with self._lock:
+            self._local_counts[ref.id] = self._local_counts.get(ref.id, 0) + 1
+        if not self._worker.owns(ref):
+            self._worker.send_add_borrow(ref.owner_address, ref.id)
+
+    def mark_escaped(self, ref: ObjectRef) -> None:
+        if not self._worker.owns(ref):
+            return
+        with self._lock:
+            self._escaped.add(ref.id)
+
+    def owner_add_borrow(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._borrows[oid] = self._borrows.get(oid, 0) + 1
+
+    def owner_release_borrow(self, oid: ObjectID) -> None:
+        with self._lock:
+            n = self._borrows.get(oid, 0) - 1
+            if n <= 0:
+                self._borrows.pop(oid, None)
+            else:
+                self._borrows[oid] = n
+
+
+class _ActorRuntime:
+    """Executor-side state when this worker hosts an actor."""
+
+    def __init__(self, actor_id: str, instance, max_concurrency: int):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.max_concurrency = max_concurrency
+        self.queue: "queue.Queue" = queue.Queue()
+        self.threads: List[threading.Thread] = []
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        control_address: str,
+        node_agent_address: str,
+        session_id: str,
+        node_id_hex: str,
+        job_id: Optional[JobID] = None,
+    ):
+        self.mode = mode
+        self.worker_id = WorkerID.from_random()
+        self.session_id = session_id
+        self.node_id_hex = node_id_hex
+        self.control_address = control_address
+        self.node_agent_address = node_agent_address
+
+        self.server = RpcServer(f"{mode}-worker")
+        self.server.register_instance(self)
+        self.server.register_raw("actor_task", self._raw_actor_task)
+        self.server.start()
+
+        self.control = RpcClient(control_address, name=f"{mode}->cs")
+        self.agent = RpcClient(node_agent_address, name=f"{mode}->agent")
+        self.workers = ClientPool("w2w")
+        self.agents = ClientPool("w2agent")
+
+        self.memory_store = MemoryStore()
+        self.shm = ShmClient()
+        self.reference_tracker = ReferenceTracker(self)
+
+        self.job_id = job_id or JobID.nil()
+        self.driver_task_id: Optional[TaskID] = None
+        self._task_index_lock = threading.Lock()
+        self._put_index = 0
+
+        self._registered_fns: set = set()
+        self._fn_cache: Dict[str, Any] = {}
+
+        self._submit_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="submit"
+        )
+        # per-actor ordered senders + address cache
+        self._actor_senders: Dict[str, "_ActorSender"] = {}
+        self._actor_senders_lock = threading.Lock()
+        self._actor_addr_cache: Dict[str, str] = {}
+
+        self._actor_runtime: Optional[_ActorRuntime] = None
+        self._current_ctx = threading.local()
+        self._shutdown = threading.Event()
+
+        # cancellation + bookkeeping of in-flight executions
+        self._running_tasks: Dict[str, Dict[str, Any]] = {}
+        self._cancelled_tasks: set = set()
+        # owner side: task_id hex -> worker address currently executing it
+        self._inflight_push: Dict[str, str] = {}
+
+    # ------------------------------------------------------------------
+    # identity / context
+    # ------------------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def owns(self, ref: ObjectRef) -> bool:
+        return ref.owner_address == self.address
+
+    def current_task_id(self) -> Optional[TaskID]:
+        return getattr(self._current_ctx, "task_id", None) or self.driver_task_id
+
+    def current_actor_id(self) -> Optional[str]:
+        if self._actor_runtime is not None:
+            return self._actor_runtime.actor_id
+        return None
+
+    def current_job_id(self) -> JobID:
+        ctx_job = getattr(self._current_ctx, "job_id", None)
+        return ctx_job or self.job_id
+
+    def _next_task_id(self) -> TaskID:
+        return TaskID.for_normal_task(self.current_job_id())
+
+    # ------------------------------------------------------------------
+    # connection bring-up
+    # ------------------------------------------------------------------
+
+    def connect_driver(self) -> None:
+        job_hex = self.control.call(
+            "register_job", driver_address=self.address, metadata={"pid": os.getpid()},
+            retryable=True,
+        )
+        self.job_id = JobID.from_hex(job_hex)
+        self.driver_task_id = TaskID.for_driver(self.job_id)
+        self._subscribe_actor_updates()
+
+    def _subscribe_actor_updates(self) -> None:
+        """Track actor address changes via control-store pubsub (parity:
+        callers resolve actor location via GCS subscribe, SURVEY.md §3.3)."""
+
+        def on_pubsub(payload):
+            topic, data = payload
+            if topic != "actor":
+                return
+            aid = data.get("actor_id")
+            if not aid:
+                return
+            if data.get("state") == "ALIVE" and data.get("worker_address"):
+                self._actor_addr_cache[aid] = data["worker_address"]
+            else:
+                self._actor_addr_cache.pop(aid, None)
+
+        self.control.on_push("pubsub", on_pubsub)
+        self.control.call("subscribe", topics=["actor"], retryable=True)
+
+    def connect_worker(self) -> None:
+        self.agent.call(
+            "register_worker",
+            worker_id=self.worker_id.hex(),
+            address=self.address,
+            pid=os.getpid(),
+            kind=getattr(self, "worker_kind", "cpu"),
+            retryable=True,
+        )
+        self._subscribe_actor_updates()
+        t = threading.Thread(target=self._agent_watchdog, name="agent-watch", daemon=True)
+        t.start()
+
+    def _agent_watchdog(self) -> None:
+        """Exit if the node agent goes away (orphan prevention: a node's
+        workers die with the node, as the reference raylet guarantees)."""
+        failures = 0
+        while not self._shutdown.wait(2.0):
+            try:
+                self.agent.call("store_usage", timeout_s=5.0)
+                failures = 0
+            except RpcConnectionError:
+                # connection refused/reset: the agent process is gone
+                failures += 3
+            except RpcError:
+                # slow but alive (CPU contention): be patient
+                failures += 1
+            if failures >= 3:
+                logger.warning("node agent unreachable; worker exiting")
+                os._exit(1)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._submit_pool.shutdown(wait=False)
+        self.server.stop()
+        self.control.close()
+        self.agent.close()
+        self.workers.close_all()
+        self.agents.close_all()
+        self.shm.close()
+
+    # ------------------------------------------------------------------
+    # function table
+    # ------------------------------------------------------------------
+
+    def register_function(self, fn_id: str, blob: bytes, name: str) -> None:
+        if fn_id in self._registered_fns:
+            return
+        self.control.call("kv_put", ns="fn", key=fn_id, value=blob, overwrite=False,
+                          retryable=True)
+        self._registered_fns.add(fn_id)
+
+    def load_function(self, fn_id: str):
+        fn = self._fn_cache.get(fn_id)
+        if fn is None:
+            blob = self.control.call("kv_get", ns="fn", key=fn_id, retryable=True)
+            if blob is None:
+                raise RuntimeError(f"function {fn_id} not found in function table")
+            fn = serialization.loads(blob)
+            self._fn_cache[fn_id] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # put / get / wait / free (reference core_worker.h:486,662,702)
+    # ------------------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        with self._task_index_lock:
+            self._put_index += 1
+            idx = self._put_index
+        task_id = self.current_task_id() or TaskID.for_driver(self.current_job_id())
+        oid = ObjectID.from_task(task_id, 2**31 + idx)
+        frame = serialization.pack(value)
+        self._store_frame_maybe_plasma(oid, frame)
+        return ObjectRef(oid, self.address)
+
+    def _store_frame_maybe_plasma(self, oid: ObjectID, frame: bytes) -> None:
+        if len(frame) > config.max_direct_call_object_size:
+            path = self.agent.call("create_object", oid_hex=oid.hex(), size=len(frame))
+            self.shm.write(path, frame)
+            self.agent.call("seal_object", oid_hex=oid.hex())
+            self.memory_store.put(
+                oid, PlasmaValue(path, len(frame), self.node_agent_address)
+            )
+        else:
+            self.memory_store.put(oid, frame)
+
+    def get(self, refs: List[ObjectRef], timeout_s: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        out = []
+        for ref in refs:
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
+            out.append(self._get_one(ref, remaining))
+        return out
+
+    def _get_one(self, ref: ObjectRef, timeout_s: Optional[float]) -> Any:
+        if self.owns(ref):
+            try:
+                stored = self.memory_store.get(ref.id, timeout_s)
+            except TimeoutError:
+                raise GetTimeoutError(
+                    f"get() on {ref.id.hex()} timed out after {timeout_s}s"
+                ) from None
+            return self._materialize(stored)
+        client = self.workers.get(ref.owner_address)
+        try:
+            reply = client.call(
+                "get_object", oid_hex=ref.id.hex(), wait_s=timeout_s,
+                timeout_s=(timeout_s + 30.0) if timeout_s is not None else 86400.0,
+            )
+        except RpcTimeout:
+            raise GetTimeoutError(
+                f"get() on {ref.id.hex()} timed out after {timeout_s}s"
+            ) from None
+        except RpcConnectionError as e:
+            raise ObjectLostError(
+                f"owner of {ref.id.hex()} at {ref.owner_address} is unreachable: {e}"
+            ) from None
+        return self._materialize_reply(reply)
+
+    def _materialize(self, stored: Any) -> Any:
+        if isinstance(stored, (bytes, bytearray, memoryview)):
+            return serialization.unpack(stored)
+        if isinstance(stored, PlasmaValue):
+            view = self.shm.read_view(stored.path, stored.size)
+            return serialization.unpack(view)
+        if isinstance(stored, TaskError):
+            raise stored
+        if isinstance(stored, LostValue):
+            stored.raise_()
+        if isinstance(stored, Exception):
+            raise stored
+        raise RuntimeError(f"unexpected stored value kind: {type(stored)}")
+
+    def _materialize_reply(self, reply: Tuple[str, Any]) -> Any:
+        kind, payload = reply
+        if kind == "frame":
+            return serialization.unpack(payload)
+        if kind == "plasma":
+            path, size = payload
+            view = self.shm.read_view(path, size)
+            return serialization.unpack(view)
+        if kind == "error":
+            raise payload
+        raise RuntimeError(f"unexpected get_object reply kind {kind}")
+
+    def wait(
+        self,
+        refs: List[ObjectRef],
+        num_returns: int = 1,
+        timeout_s: Optional[float] = None,
+        fetch_local: bool = True,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            ready_now = self._poll_ready(pending)
+            still = [r for r in pending if r not in ready_now]
+            ready.extend(r for r in pending if r in ready_now)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        return ready, pending
+
+    def _poll_ready(self, refs: List[ObjectRef]) -> set:
+        """One batched readiness probe per owner (not per ref per tick)."""
+        ready: set = set()
+        by_owner: Dict[str, List[ObjectRef]] = {}
+        for ref in refs:
+            if self.owns(ref):
+                if self.memory_store.contains(ref.id):
+                    ready.add(ref)
+            else:
+                by_owner.setdefault(ref.owner_address, []).append(ref)
+        for owner, group in by_owner.items():
+            try:
+                states = self.workers.get(owner).call(
+                    "peek_objects", oid_hexes=[r.id.hex() for r in group],
+                    timeout_s=10.0,
+                )
+                for r, ok in zip(group, states):
+                    if ok:
+                        ready.add(r)
+            except RpcError:
+                # owner gone: surfacing the error counts as ready
+                ready.update(group)
+        return ready
+
+    def free(self, refs: List[ObjectRef]) -> None:
+        for ref in refs:
+            if self.owns(ref):
+                self.delete_owned_object(ref.id)
+            else:
+                try:
+                    self.workers.get(ref.owner_address).call_oneway(
+                        "free_object", oid_hex=ref.id.hex()
+                    )
+                except RpcError:
+                    pass
+
+    def delete_owned_object(self, oid: ObjectID) -> None:
+        stored = self.memory_store.try_get(oid)
+        self.memory_store.delete(oid)
+        if isinstance(stored, PlasmaValue):
+            try:
+                self.agents.get(stored.agent_address).call_oneway(
+                    "delete_objects", oid_hexes=[oid.hex()]
+                )
+            except RpcError:
+                pass
+
+    def send_add_borrow(self, owner_address: str, oid: ObjectID) -> None:
+        try:
+            self.workers.get(owner_address).call_oneway("add_borrow", oid_hex=oid.hex())
+        except RpcError:
+            pass
+
+    def send_release_borrow(self, owner_address: str, oid: ObjectID) -> None:
+        try:
+            self.workers.get(owner_address).call_oneway(
+                "release_borrow", oid_hex=oid.hex()
+            )
+        except RpcError:
+            pass
+
+    # ------------------------------------------------------------------
+    # normal task submission (reference normal_task_submitter.h:124)
+    # ------------------------------------------------------------------
+
+    def submit_task(self, fn_id, fn_name, args, kwargs, options: TaskOptions):
+        task_id = self._next_task_id()
+        refs = [
+            ObjectRef(ObjectID.from_task(task_id, i), self.address)
+            for i in range(options.num_returns)
+        ]
+        spec = TaskSpec(
+            task_id=task_id,
+            fn_id=fn_id,
+            fn_name=fn_name,
+            args_frame=serialization.pack((args, kwargs)),
+            num_returns=options.num_returns,
+            owner_address=self.address,
+            resources=options.resource_demand(default_cpus=1.0),
+            max_retries=options.max_retries,
+            retry_exceptions=options.retry_exceptions,
+            name=options.name or fn_name,
+        )
+        strategy = self._resolve_strategy(options.scheduling_strategy)
+        self._submit_pool.submit(self._submit_normal_task, spec, strategy)
+        return refs
+
+    def _resolve_strategy(self, strategy):
+        """Convert API strategy objects into the wire dict form."""
+        from ray_tpu.core.placement import PlacementGroupSchedulingStrategy
+        from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+        if strategy is None or strategy == "DEFAULT":
+            return None
+        if isinstance(strategy, str):
+            return strategy
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            return {
+                "type": "placement_group",
+                "pg_id": strategy.placement_group.id_hex,
+                "bundle_index": strategy.placement_group_bundle_index,
+            }
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            return {
+                "type": "node_affinity",
+                "node_id": strategy.node_id,
+                "soft": strategy.soft,
+            }
+        if isinstance(strategy, dict):
+            return strategy
+        raise TypeError(f"unsupported scheduling strategy {strategy!r}")
+
+    def _submit_normal_task(self, spec: TaskSpec, strategy) -> None:
+        attempts = spec.max_retries + 1
+        last_error: Optional[Exception] = None
+        for attempt in range(attempts):
+            if spec.task_id.hex() in self._cancelled_tasks:
+                err = TaskCancelledError(f"task {spec.name} was cancelled")
+                for i in range(spec.num_returns):
+                    self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+                return
+            try:
+                self._run_task_on_lease(spec, strategy)
+                return
+            except (RpcConnectionError, RpcTimeout, WorkerCrashedError) as e:
+                last_error = e
+                logger.warning(
+                    "task %s attempt %d/%d failed: %s",
+                    spec.name, attempt + 1, attempts, e,
+                )
+                continue
+            except TaskError as e:
+                last_error = e
+                if spec.retry_exceptions and attempt + 1 < attempts:
+                    continue
+                break
+            except Exception as e:  # noqa: BLE001 — store scheduling errors
+                last_error = e
+                break
+        err = last_error
+        if not isinstance(err, TaskError):
+            err = TaskError(
+                f"task {spec.name} failed after {attempts} attempts: {last_error}",
+            )
+        for i in range(spec.num_returns):
+            self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+
+    def _run_task_on_lease(self, spec: TaskSpec, strategy) -> None:
+        bundle = None
+        if isinstance(strategy, dict) and strategy.get("type") == "placement_group":
+            bundle = (strategy["pg_id"], strategy.get("bundle_index"))
+        agent = self.agent
+        hops = 0
+        while True:
+            lease = agent.call(
+                "lease_worker",
+                resources=spec.resources,
+                bundle=bundle,
+                strategy=strategy,
+                wait_s=30.0,
+                timeout_s=45.0,
+            )
+            if lease.get("granted"):
+                break
+            spill = lease.get("spillback")
+            if spill:
+                hops += 1
+                if hops > 16:
+                    raise TaskError(f"task {spec.name}: too many spillback hops")
+                agent = self.agents.get(spill)
+                continue
+            if lease.get("error") == "lease timeout":
+                continue  # stay queued (reference behavior: leases wait)
+            raise TaskError(
+                f"task {spec.name} unschedulable: {lease.get('error')} "
+                f"(resources={spec.resources})"
+            )
+        worker_addr = lease["worker_address"]
+        lease_id = lease["lease_id"]
+        if spec.task_id.hex() in self._cancelled_tasks:
+            # cancelled while waiting for the lease
+            try:
+                agent.call_oneway("release_worker", lease_id=lease_id, kill=False)
+            except RpcError:
+                pass
+            err = TaskCancelledError(f"task {spec.name} was cancelled")
+            for i in range(spec.num_returns):
+                self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+            return
+        kill = False
+        self._inflight_push[spec.task_id.hex()] = worker_addr
+        try:
+            client = self.workers.get(worker_addr)
+            # Task duration is unbounded: effectively no RPC timeout here;
+            # worker death is detected by connection loss instead.
+            reply = client.call("push_task", spec=spec, timeout_s=86400.0 * 30)
+            self._store_task_reply(spec, reply)
+        except (RpcConnectionError, RpcTimeout):
+            self.workers.drop(worker_addr)
+            kill = True
+            raise WorkerCrashedError(
+                f"worker {worker_addr} died while executing {spec.name}"
+            ) from None
+        finally:
+            self._inflight_push.pop(spec.task_id.hex(), None)
+            try:
+                agent.call_oneway("release_worker", lease_id=lease_id, kill=kill)
+            except RpcError:
+                pass
+
+    def _store_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        if reply["status"] == "ok":
+            for oid_hex, (kind, payload) in reply["returns"]:
+                oid = ObjectID.from_hex(oid_hex)
+                if kind == "frame":
+                    self.memory_store.put(oid, payload)
+                elif kind == "plasma":
+                    path, size, agent_addr = payload
+                    self.memory_store.put(oid, PlasmaValue(path, size, agent_addr))
+        elif reply["status"] == "cancelled":
+            err = TaskCancelledError(f"task {spec.name} was cancelled")
+            for i in range(spec.num_returns):
+                self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+        else:
+            error: TaskError = reply["error"]
+            if spec.retry_exceptions:
+                raise error
+            for i in range(spec.num_returns):
+                self.memory_store.put(ObjectID.from_task(spec.task_id, i), error)
+
+    # ------------------------------------------------------------------
+    # actor submission (reference actor_task_submitter.h)
+    # ------------------------------------------------------------------
+
+    def create_actor(self, class_id, class_blob, class_name, init_args, init_kwargs,
+                     actor_options) -> str:
+        actor_id = ActorID.of(self.current_job_id()).hex()
+        self.register_function(class_id, class_blob, class_name)
+        spec = {
+            "actor_id": actor_id,
+            "job_id": self.current_job_id().hex(),
+            "class_id": class_id,
+            "class_name": class_name,
+            "init_args_frame": serialization.pack((init_args, init_kwargs)),
+            "resources": actor_options.get("resources", {}),
+            "name": actor_options.get("name"),
+            "namespace": actor_options.get("namespace", "default"),
+            "lifetime": actor_options.get("lifetime"),
+            "max_restarts": actor_options.get("max_restarts", 0),
+            "max_concurrency": actor_options.get("max_concurrency", 1),
+            "method_names": actor_options.get("method_names", []),
+            "scheduling_strategy": self._resolve_strategy(
+                actor_options.get("scheduling_strategy")
+            ),
+            "owner_address": self.address,
+        }
+        self.control.call("register_actor", spec=spec, retryable=True)
+        return actor_id
+
+    def _actor_sender(self, actor_id: str) -> "_ActorSender":
+        with self._actor_senders_lock:
+            sender = self._actor_senders.get(actor_id)
+            if sender is None:
+                sender = _ActorSender(self, actor_id)
+                self._actor_senders[actor_id] = sender
+        return sender
+
+    def _resolve_actor_address(self, actor_id: str, timeout_s: float = 60.0) -> str:
+        """Block until the actor is ALIVE (pending creation / restart /
+        resource queuing can legitimately take long — reference callers
+        block on the GCS actor table the same way)."""
+        addr = self._actor_addr_cache.get(actor_id)
+        if addr:
+            return addr
+        while True:
+            info = self.control.call(
+                "wait_actor_alive", actor_id=actor_id, wait_s=timeout_s,
+                timeout_s=timeout_s + 30.0, retryable=True,
+            )
+            if info is None:
+                raise ActorDiedError(f"actor {actor_id} does not exist")
+            if info["state"] == "DEAD":
+                raise ActorDiedError(
+                    f"actor {actor_id} is dead: {info.get('death_cause')}"
+                )
+            if info["state"] == "ALIVE" and info.get("worker_address"):
+                self._actor_addr_cache[actor_id] = info["worker_address"]
+                return info["worker_address"]
+            if self._shutdown.is_set():
+                raise ActorUnavailableError(f"actor {actor_id} is {info['state']}")
+            time.sleep(0.05)
+
+    def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs,
+                          num_returns: int = 1) -> List[ObjectRef]:
+        task_id = TaskID.for_actor_task(ActorID.from_hex(actor_id))
+        refs = [
+            ObjectRef(ObjectID.from_task(task_id, i), self.address)
+            for i in range(num_returns)
+        ]
+        spec = TaskSpec(
+            task_id=task_id,
+            fn_id="",
+            fn_name=method_name,
+            args_frame=serialization.pack((args, kwargs)),
+            num_returns=num_returns,
+            owner_address=self.address,
+            resources={},
+            actor_id=actor_id,
+            method_name=method_name,
+            name=f"{actor_id[:8]}.{method_name}",
+        )
+        self._actor_sender(actor_id).submit(spec)
+        return refs
+
+    def _store_actor_task_failure(self, spec: TaskSpec, e: Exception) -> None:
+        if not isinstance(e, (TaskError, ActorDiedError, ActorUnavailableError)):
+            e = TaskError(f"actor task {spec.name} failed: {e}", traceback.format_exc())
+        for i in range(spec.num_returns):
+            self.memory_store.put(ObjectID.from_task(spec.task_id, i), e)
+
+    def _actor_connection_lost(self, spec: TaskSpec) -> Exception:
+        """Classify a connection loss for an in-flight actor task.
+
+        At-most-once semantics (reference default max_task_retries=0): the
+        task may or may not have executed, so it is NEVER silently resent —
+        the caller gets ActorDiedError (permanent) or ActorUnavailableError
+        (actor restarting; new calls will reach the restarted actor)."""
+        self._actor_addr_cache.pop(spec.actor_id, None)
+        try:
+            info = self.control.call(
+                "get_actor_info", actor_id=spec.actor_id, retryable=True
+            )
+        except RpcError:
+            info = None
+        if info is None or info["state"] == "DEAD":
+            return ActorDiedError(
+                f"actor {spec.actor_id[:8]} died: "
+                f"{info.get('death_cause') if info else 'unknown'}"
+            )
+        return ActorUnavailableError(
+            f"actor {spec.actor_id[:8]} is {info['state']}; in-flight call "
+            f"{spec.name} failed (not retried: at-most-once semantics)"
+        )
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
+        self.control.call("kill_actor", actor_id=actor_id, no_restart=no_restart)
+        self._actor_addr_cache.pop(actor_id, None)
+
+    def cancel_task(self, ref: ObjectRef) -> None:
+        """Best-effort cancel (reference core_worker.h Cancel): tasks not
+        yet dispatched are dropped owner-side; tasks already pushed get a
+        cancel RPC so the executor skips them if they haven't started.
+        A task already running is not interrupted (force-cancel is a later
+        round: it needs executor-side thread interruption)."""
+        task_hex = ref.task_id().hex()
+        self._cancelled_tasks.add(task_hex)
+        worker_addr = self._inflight_push.get(task_hex)
+        if worker_addr:
+            try:
+                self.workers.get(worker_addr).call_oneway(
+                    "cancel_task", task_id_hex=task_hex
+                )
+            except RpcError:
+                pass
+
+    # ------------------------------------------------------------------
+    # execution side: worker service RPCs
+    # ------------------------------------------------------------------
+
+    def rpc_push_task(self, conn, spec: TaskSpec):
+        return self._execute_spec(spec)
+
+    def _raw_actor_task(self, conn, req_id, args, kwargs) -> None:
+        spec: TaskSpec = kwargs.get("spec") or args[0]
+        rt = self._actor_runtime
+        if rt is None:
+            RpcServer.reply(
+                conn, req_id, False,
+                RemoteError("this worker hosts no actor", ""),
+            )
+            return
+        rt.queue.put((conn, req_id, spec))
+
+    def _actor_loop(self) -> None:
+        rt = self._actor_runtime
+        while not self._shutdown.is_set():
+            try:
+                conn, req_id, spec = rt.queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            reply = self._execute_spec(spec)
+            RpcServer.reply(conn, req_id, True, reply)
+
+    def rpc_create_actor(self, conn, spec: Dict[str, Any]):
+        """Returns {"ok": True} or {"ok": False, "error": TaskError}.
+
+        Application-level __init__ failures travel as data, NOT as RPC
+        errors — the control store must distinguish "constructor raised"
+        (actor is DEAD, tell the user why) from "transport failed" (retry
+        on another worker)."""
+        try:
+            cls = self.load_function(spec["class_id"])
+            args, kwargs = serialization.unpack(spec["init_args_frame"])
+            args = [self._resolve_arg(a) for a in args]
+            kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+            self._current_ctx.job_id = JobID.from_hex(spec["job_id"])
+            instance = cls(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001
+            return {
+                "ok": False,
+                "error": TaskError(
+                    f"actor {spec['class_name']}.__init__ failed: {e}",
+                    traceback.format_exc(),
+                ),
+            }
+        rt = _ActorRuntime(
+            spec["actor_id"], instance, int(spec.get("max_concurrency", 1))
+        )
+        self._actor_runtime = rt
+        for i in range(rt.max_concurrency):
+            t = threading.Thread(
+                target=self._actor_loop, name=f"actor-exec-{i}", daemon=True
+            )
+            t.start()
+            rt.threads.append(t)
+        return {"ok": True}
+
+    def _execute_spec(self, spec: TaskSpec) -> Dict[str, Any]:
+        if spec.task_id.hex() in self._cancelled_tasks:
+            return {"status": "cancelled"}
+        self._current_ctx.task_id = spec.task_id
+        self._current_ctx.job_id = spec.task_id.job_id()
+        self._running_tasks[spec.task_id.hex()] = {"name": spec.name}
+        try:
+            if spec.actor_id is not None:
+                rt = self._actor_runtime
+                target = getattr(rt.instance, spec.method_name, None)
+                if target is None:
+                    raise AttributeError(
+                        f"actor has no method {spec.method_name!r}"
+                    )
+            else:
+                target = self.load_function(spec.fn_id)
+            args, kwargs = serialization.unpack(spec.args_frame)
+            args = [self._resolve_arg(a) for a in args]
+            kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+            result = target(*args, **kwargs)
+            returns = self._package_returns(spec, result)
+            return {"status": "ok", "returns": returns}
+        except TaskError as e:
+            return {"status": "error", "error": e}
+        except Exception as e:  # noqa: BLE001 — forwarded to the owner
+            return {
+                "status": "error",
+                "error": TaskError(
+                    f"{type(e).__name__}: {e}", traceback.format_exc(), cause=e
+                ),
+            }
+        finally:
+            self._running_tasks.pop(spec.task_id.hex(), None)
+            self._current_ctx.task_id = None
+
+    def _resolve_arg(self, value: Any) -> Any:
+        if isinstance(value, ObjectRef):
+            return self._get_one(value, timeout_s=None)
+        return value
+
+    def _package_returns(self, spec: TaskSpec, result: Any) -> List[Tuple[str, Any]]:
+        if spec.num_returns == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {len(values)} values"
+                )
+        returns = []
+        for i, value in enumerate(values):
+            oid = ObjectID.from_task(spec.task_id, i)
+            frame = serialization.pack(value)
+            if len(frame) > config.max_direct_call_object_size:
+                path = self.agent.call(
+                    "create_object", oid_hex=oid.hex(), size=len(frame)
+                )
+                self.shm.write(path, frame)
+                self.agent.call("seal_object", oid_hex=oid.hex())
+                returns.append(
+                    (oid.hex(), ("plasma", (path, len(frame), self.node_agent_address)))
+                )
+            else:
+                returns.append((oid.hex(), ("frame", frame)))
+        return returns
+
+    # -- object service (owner side) --
+
+    def rpc_get_object(self, conn, oid_hex: str, wait_s: Optional[float] = None):
+        oid = ObjectID.from_hex(oid_hex)
+        try:
+            stored = self.memory_store.get(oid, wait_s)
+        except TimeoutError:
+            return ("error", GetTimeoutError(f"object {oid_hex} not ready"))
+        if isinstance(stored, (bytes, bytearray)):
+            return ("frame", stored)
+        if isinstance(stored, PlasmaValue):
+            return ("plasma", (stored.path, stored.size))
+        if isinstance(stored, LostValue):
+            return ("error", ObjectLostError(stored.message))
+        if isinstance(stored, Exception):
+            return ("error", stored)
+        return ("error", RuntimeError(f"bad stored kind {type(stored)}"))
+
+    def rpc_peek_object(self, conn, oid_hex: str):
+        return self.memory_store.contains(ObjectID.from_hex(oid_hex))
+
+    def rpc_peek_objects(self, conn, oid_hexes: List[str]):
+        return [
+            self.memory_store.contains(ObjectID.from_hex(h)) for h in oid_hexes
+        ]
+
+    def rpc_free_object(self, conn, oid_hex: str):
+        self.delete_owned_object(ObjectID.from_hex(oid_hex))
+        return True
+
+    def rpc_add_borrow(self, conn, oid_hex: str):
+        self.reference_tracker.owner_add_borrow(ObjectID.from_hex(oid_hex))
+        return True
+
+    def rpc_release_borrow(self, conn, oid_hex: str):
+        self.reference_tracker.owner_release_borrow(ObjectID.from_hex(oid_hex))
+        return True
+
+    def rpc_cancel_task(self, conn, task_id_hex: str):
+        self._cancelled_tasks.add(task_id_hex)
+        return True
+
+    def rpc_ping(self, conn):
+        return {"worker_id": self.worker_id.hex(), "mode": self.mode,
+                "actor": self.current_actor_id()}
+
+    def rpc_exit_worker(self, conn):
+        def _die():
+            time.sleep(0.05)
+            os._exit(0)
+
+        threading.Thread(target=_die, daemon=True).start()
+        return True
+
+
+class _ActorSender:
+    """Caller-side ordered, pipelined actor task submission.
+
+    Parity: ActorTaskSubmitter's per-caller sequence ordering (reference
+    src/ray/core_worker/task_submission/actor_task_submitter.h). One sender
+    thread serializes the sends (so frames hit the actor's socket in
+    submission order — the server's raw handler enqueues them in arrival
+    order), while a waiter thread collects replies, keeping many calls in
+    flight. After a connection break the affected call falls back to the
+    synchronous resend path and strict ordering is relaxed for the tail
+    (the reference similarly re-queues on actor restart).
+    """
+
+    def __init__(self, worker: CoreWorker, actor_id: str):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.specs: "queue.Queue" = queue.Queue()
+        self.inflight: "queue.Queue" = queue.Queue()
+        self._sender = threading.Thread(
+            target=self._send_loop, name=f"actor-send-{actor_id[:8]}", daemon=True
+        )
+        self._waiter = threading.Thread(
+            target=self._wait_loop, name=f"actor-wait-{actor_id[:8]}", daemon=True
+        )
+        self._sender.start()
+        self._waiter.start()
+
+    def submit(self, spec: TaskSpec) -> None:
+        self.specs.put(spec)
+
+    def _send_loop(self) -> None:
+        w = self.worker
+        while not w._shutdown.is_set():
+            try:
+                spec = self.specs.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            # A failed *send* (frame never accepted by the socket) is safe
+            # to retry against the restarted actor; once the frame is out,
+            # failures are classified by _actor_connection_lost instead.
+            addr = None
+            for _ in range(3):
+                try:
+                    addr = w._resolve_actor_address(spec.actor_id)
+                    client = w.workers.get(addr)
+                    pending = client.call_async("actor_task", spec=spec)
+                    self.inflight.put((pending, spec))
+                    break
+                except (RpcConnectionError, RpcTimeout):
+                    w._actor_addr_cache.pop(spec.actor_id, None)
+                    if addr is not None:
+                        w.workers.drop(addr)
+                    time.sleep(0.1)
+                    continue
+                except Exception as e:  # noqa: BLE001
+                    w._store_actor_task_failure(spec, e)
+                    break
+            else:
+                w._store_actor_task_failure(spec, w._actor_connection_lost(spec))
+
+    def _wait_loop(self) -> None:
+        w = self.worker
+        while not w._shutdown.is_set():
+            try:
+                pending, spec = self.inflight.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                reply = pending.wait(None)
+                w._store_task_reply(spec, reply)
+            except (RpcConnectionError, RpcTimeout):
+                w._store_actor_task_failure(spec, w._actor_connection_lost(spec))
+            except Exception as e:  # noqa: BLE001
+                w._store_actor_task_failure(spec, e)

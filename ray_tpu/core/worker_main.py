@@ -1,0 +1,56 @@
+"""Worker process entrypoint (parity: python/ray/_private/workers/
+default_worker.py). Spawned by the node agent's worker pool.
+
+Deliberately import-light: no JAX import at startup so the pool can spin up
+workers in ~100ms; JAX loads lazily the first time a task touches it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-address", required=True)
+    parser.add_argument("--control-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session-id", required=True)
+    parser.add_argument("--kind", default="cpu")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",
+    )
+
+    from ray_tpu.utils.config import config
+
+    snapshot = os.environ.get("RT_CONFIG_SNAPSHOT")
+    if snapshot:
+        config.load_snapshot(snapshot)
+
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.CoreWorker(
+        mode="worker",
+        control_address=args.control_address,
+        node_agent_address=args.node_address,
+        session_id=args.session_id,
+        node_id_hex=args.node_id,
+    )
+    w.worker_kind = args.kind
+    worker_mod.set_global_worker(w)
+    w.connect_worker()
+
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

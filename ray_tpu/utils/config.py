@@ -1,0 +1,110 @@
+"""Config registry: typed flags with env-var overrides.
+
+Equivalent of the reference's RAY_CONFIG system
+(src/ray/common/ray_config_def.h — ~230 flags, overridable via RAY_<name>
+env vars, head-distributed to all nodes). Here: ``define(name, default)``
+registers a flag; ``RT_<NAME>`` env vars override; the head node snapshots
+its config and ships it to joining nodes so a cluster shares one view.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RT_"
+
+
+class _Flag:
+    __slots__ = ("name", "default", "parser", "value", "overridden")
+
+    def __init__(self, name: str, default: Any, parser: Callable[[str], Any]):
+        self.name = name
+        self.default = default
+        self.parser = parser
+        self.overridden = False
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            self.value = parser(env)
+            self.overridden = True
+        else:
+            self.value = default
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class Config:
+    """Process-global flag registry."""
+
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any) -> None:
+        if isinstance(default, bool):
+            parser: Callable[[str], Any] = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+        with self._lock:
+            if name not in self._flags:
+                self._flags[name] = _Flag(name, default, parser)
+
+    def get(self, name: str) -> Any:
+        return self._flags[name].value
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._flags[name].value = value
+            self._flags[name].overridden = True
+
+    def snapshot(self) -> str:
+        """Serialize current values (for head → node distribution)."""
+        with self._lock:
+            return json.dumps({k: f.value for k, f in self._flags.items()})
+
+    def load_snapshot(self, payload: str) -> None:
+        """Apply a head-node snapshot; local env overrides still win."""
+        data = json.loads(payload)
+        with self._lock:
+            for k, v in data.items():
+                flag = self._flags.get(k)
+                if flag is not None and not flag.overridden:
+                    flag.value = v
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._flags[name].value
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+config = Config()
+
+# --- Core flags (subset of the reference's ray_config_def.h surface) ---
+config.define("rpc_connect_timeout_s", 10.0)
+config.define("rpc_request_timeout_s", 60.0)
+config.define("rpc_max_retries", 3)
+config.define("rpc_retry_delay_s", 0.1)
+# Fault injection: "Service.Method:p_request:p_response" comma list
+# (mirror of RAY_testing_rpc_failure, src/ray/common/ray_config_def.h:862).
+config.define("testing_rpc_failure", "")
+config.define("health_check_period_s", 1.0)
+config.define("health_check_timeout_s", 10.0)
+config.define("max_direct_call_object_size", 100 * 1024)
+config.define("object_store_memory_mb", 1024)
+config.define("worker_register_timeout_s", 30.0)
+config.define("worker_pool_prestart", 0)
+config.define("worker_idle_timeout_s", 600.0)
+config.define("scheduler_spread_threshold", 0.5)
+config.define("task_max_retries", 3)
+config.define("actor_max_restarts", 0)
+config.define("log_to_driver", True)
+config.define("temp_dir", "/tmp/ray_tpu")

@@ -1,0 +1,170 @@
+"""Binary identifiers for jobs, tasks, actors and objects.
+
+Design follows the reference's lineage-embedding scheme
+(src/ray/common/id.h): an ObjectID embeds the TaskID that created it plus an
+index; a TaskID embeds the JobID (and ActorID for actor tasks). This lets any
+process recover "which task produced this object" without a directory lookup
+— the property the ownership and lineage-reconstruction protocols rely on.
+
+Sizes (bytes):
+  JobID    4
+  ActorID  4 (job) + 8 (unique)            = 12
+  TaskID   12 (actor-or-padding) + 8 (unique) = 20
+  ObjectID 20 (task) + 4 (index)           = 24
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_JOB_LEN = 4
+_ACTOR_UNIQUE_LEN = 8
+_ACTOR_LEN = _JOB_LEN + _ACTOR_UNIQUE_LEN  # 12
+_TASK_UNIQUE_LEN = 8
+_TASK_LEN = _ACTOR_LEN + _TASK_UNIQUE_LEN  # 20
+_INDEX_LEN = 4
+_OBJECT_LEN = _TASK_LEN + _INDEX_LEN  # 24
+
+_NIL_ACTOR_UNIQUE = b"\x00" * _ACTOR_UNIQUE_LEN
+
+
+class BaseID:
+    """Immutable binary id with hex round-tripping."""
+
+    SIZE = 0
+    __slots__ = ("_binary",)
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        object.__setattr__(self, "_binary", binary)
+
+    def __setattr__(self, name, value):  # immutability
+        raise AttributeError(f"{type(self).__name__} is immutable")
+
+    def binary(self) -> bytes:
+        return self._binary
+
+    def hex(self) -> str:
+        return self._binary.hex()
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._binary == b"\x00" * self.SIZE
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._binary == self._binary
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._binary))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._binary,))
+
+
+class JobID(BaseID):
+    SIZE = _JOB_LEN
+    __slots__ = ()
+
+    _counter = [0]
+    _lock = threading.Lock()
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(value.to_bytes(_JOB_LEN, "little"))
+
+    def int_value(self) -> int:
+        return int.from_bytes(self._binary, "little")
+
+
+class ActorID(BaseID):
+    SIZE = _ACTOR_LEN
+    __slots__ = ()
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(job_id.binary() + os.urandom(_ACTOR_UNIQUE_LEN))
+
+    def job_id(self) -> JobID:
+        return JobID(self._binary[:_JOB_LEN])
+
+
+class TaskID(BaseID):
+    SIZE = _TASK_LEN
+    __slots__ = ()
+
+    @classmethod
+    def for_normal_task(cls, job_id: JobID) -> "TaskID":
+        prefix = job_id.binary() + _NIL_ACTOR_UNIQUE
+        return cls(prefix + os.urandom(_TASK_UNIQUE_LEN))
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(actor_id.binary() + os.urandom(_TASK_UNIQUE_LEN))
+
+    @classmethod
+    def for_driver(cls, job_id: JobID) -> "TaskID":
+        """The implicit "driver task" that owns objects created by the driver."""
+        prefix = job_id.binary() + _NIL_ACTOR_UNIQUE
+        return cls(prefix + b"\xff" * _TASK_UNIQUE_LEN)
+
+    def job_id(self) -> JobID:
+        return JobID(self._binary[:_JOB_LEN])
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._binary[:_ACTOR_LEN])
+
+    def is_actor_task(self) -> bool:
+        return self._binary[_JOB_LEN:_ACTOR_LEN] != _NIL_ACTOR_UNIQUE
+
+
+class ObjectID(BaseID):
+    SIZE = _OBJECT_LEN
+    __slots__ = ()
+
+    @classmethod
+    def from_task(cls, task_id: TaskID, index: int) -> "ObjectID":
+        if not 0 <= index < 2**32:
+            raise ValueError(f"return index out of range: {index}")
+        return cls(task_id.binary() + index.to_bytes(_INDEX_LEN, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._binary[:_TASK_LEN])
+
+    def job_id(self) -> JobID:
+        return JobID(self._binary[:_JOB_LEN])
+
+    def index(self) -> int:
+        return int.from_bytes(self._binary[_TASK_LEN:], "little")
+
+
+class NodeID(BaseID):
+    SIZE = 16
+    __slots__ = ()
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+    __slots__ = ()
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+    __slots__ = ()

@@ -1,0 +1,540 @@
+"""TCP RPC substrate: framed request/response + server push, retry, chaos.
+
+Equivalent of the reference's gRPC wrappers (src/ray/rpc/grpc_server.h:86,
+client_call.h:203, retryable_grpc_client.cc) and its fault-injection hook
+(rpc_chaos.cc). One RpcServer per daemon (control store / node agent /
+worker); RpcClient is thread-safe and multiplexes concurrent calls over one
+connection. Push messages implement the pubsub substrate (reference C16).
+
+Frame: [8-byte LE length][pickled message]
+Messages:
+  ("req",  req_id, method, args, kwargs)
+  ("resp", req_id, ok, payload)          # payload = result or exception
+  ("push", topic, payload)               # server → client, no req_id
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket
+import struct
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.utils import serialization
+from ray_tpu.utils.config import config
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionError(RpcError):
+    pass
+
+
+class RpcTimeout(RpcError):
+    pass
+
+
+class RemoteError(RpcError):
+    """Exception raised on the server, re-raised at the caller."""
+
+    def __init__(self, message: str, remote_traceback: str = ""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+
+# ---------------------------------------------------------------------------
+# Chaos / fault injection (mirror of src/ray/rpc/rpc_chaos.{h,cc})
+# ---------------------------------------------------------------------------
+
+
+def _chaos_probabilities(method: str) -> Tuple[float, float]:
+    spec = config.testing_rpc_failure
+    if not spec:
+        return 0.0, 0.0
+    for entry in spec.split(","):
+        parts = entry.strip().split(":")
+        if len(parts) >= 1 and parts[0] == method:
+            p_req = float(parts[1]) if len(parts) > 1 else 0.0
+            p_resp = float(parts[2]) if len(parts) > 2 else 0.0
+            return p_req, p_resp
+    return 0.0, 0.0
+
+
+def maybe_inject_request_failure(method: str) -> None:
+    p_req, _ = _chaos_probabilities(method)
+    if p_req > 0 and random.random() < p_req:
+        raise RpcConnectionError(f"[chaos] injected request failure for {method}")
+
+
+def maybe_inject_response_failure(method: str) -> None:
+    _, p_resp = _chaos_probabilities(method)
+    if p_resp > 0 and random.random() < p_resp:
+        raise RpcConnectionError(f"[chaos] injected response failure for {method}")
+
+
+# ---------------------------------------------------------------------------
+# Framing helpers
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, payload: bytes, lock: threading.Lock) -> None:
+    with lock:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    return _recv_exact(sock, length)
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+class ClientConnection:
+    """Server-side handle to one connected client (for pushes)."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = sock
+        self.addr = addr
+        self.send_lock = threading.Lock()
+        self.alive = True
+        self.meta: Dict[str, Any] = {}  # server code can stash identity here
+
+    def push(self, topic: str, payload: Any) -> bool:
+        if not self.alive:
+            return False
+        try:
+            _send_frame(
+                self.sock,
+                serialization.dumps(("push", topic, payload)),
+                self.send_lock,
+            )
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+
+class RpcServer:
+    """Threaded TCP RPC server.
+
+    Handlers: ``server.register(name, fn)``; fn(conn, *args, **kwargs).
+    The first argument is the ClientConnection so handlers can register
+    subscribers / track identity.
+    """
+
+    def __init__(self, name: str, host: str = "127.0.0.1", port: int = 0):
+        self.name = name
+        self._handlers: Dict[str, Callable] = {}
+        self._raw_handlers: Dict[str, Callable] = {}
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(512)
+        self.host, self.port = self._listener.getsockname()
+        self._stopped = threading.Event()
+        self._conns: Dict[int, ClientConnection] = {}
+        self._conns_lock = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+        self.on_disconnect: Optional[Callable[[ClientConnection], None]] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._handlers[name] = fn
+
+    def register_raw(self, name: str, fn: Callable) -> None:
+        """Register an in-order handler: called synchronously in the
+        connection read loop as fn(conn, req_id, args, kwargs). The handler
+        must not block; it replies later via RpcServer.reply(). Used for
+        actor task queues where per-caller submission order must be
+        preserved (reference: ordered actor execution queues,
+        src/ray/core_worker/task_execution/)."""
+        self._raw_handlers[name] = fn
+
+    @staticmethod
+    def reply(conn: "ClientConnection", req_id, ok: bool, payload: Any) -> None:
+        if req_id is None:
+            return
+        try:
+            _send_frame(
+                conn.sock,
+                serialization.dumps(("resp", req_id, ok, payload)),
+                conn.send_lock,
+            )
+        except OSError:
+            conn.alive = False
+
+    def register_instance(self, obj: Any, prefix: str = "") -> None:
+        """Register every public method of obj whose name starts with rpc_."""
+        for attr in dir(obj):
+            if attr.startswith("rpc_"):
+                self._handlers[prefix + attr[4:]] = getattr(obj, attr)
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{self.name}-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = ClientConnection(sock, addr)
+            with self._conns_lock:
+                self._conns[id(conn)] = conn
+            threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name=f"{self.name}-conn", daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: ClientConnection) -> None:
+        try:
+            while not self._stopped.is_set():
+                frame = _recv_frame(conn.sock)
+                msg = serialization.loads(frame)
+                kind = msg[0]
+                if kind == "req":
+                    _, req_id, method, args, kwargs = msg
+                    raw = self._raw_handlers.get(method)
+                    if raw is not None:
+                        try:
+                            raw(conn, req_id, args, kwargs)
+                        except Exception as e:  # noqa: BLE001
+                            self.reply(conn, req_id, False,
+                                       RemoteError(f"{type(e).__name__}: {e}",
+                                                   traceback.format_exc()))
+                        continue
+                    threading.Thread(
+                        target=self._dispatch,
+                        args=(conn, req_id, method, args, kwargs),
+                        name=f"{self.name}-h-{method}",
+                        daemon=True,
+                    ).start()
+                else:
+                    logger.warning("%s: unexpected message kind %r", self.name, kind)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.alive = False
+            with self._conns_lock:
+                self._conns.pop(id(conn), None)
+            if self.on_disconnect is not None:
+                try:
+                    self.on_disconnect(conn)
+                except Exception:
+                    logger.exception("%s: on_disconnect handler failed", self.name)
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, req_id, method, args, kwargs) -> None:
+        try:
+            handler = self._handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no handler for method {method!r} on {self.name}")
+            result = handler(conn, *args, **kwargs)
+            ok, payload = True, result
+        except Exception as e:  # noqa: BLE001 — faithfully forward any error
+            ok = False
+            payload = RemoteError(
+                f"{type(e).__name__}: {e}", traceback.format_exc()
+            ) if not isinstance(e, RemoteError) else e
+        if req_id is None:  # one-way call
+            return
+        try:
+            _send_frame(
+                conn.sock,
+                serialization.dumps(("resp", req_id, ok, payload)),
+                conn.send_lock,
+            )
+        except OSError:
+            conn.alive = False
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+
+class RpcClient:
+    """Thread-safe client multiplexing calls over one connection."""
+
+    def __init__(self, address: str, name: str = "client"):
+        self.address = address
+        self.name = name
+        host, port = address.rsplit(":", 1)
+        self._host, self._port = host, int(port)
+        self._sock: Optional[socket.socket] = None
+        self._send_lock = threading.Lock()
+        self._conn_lock = threading.Lock()
+        self._pending: Dict[int, "_PendingCall"] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._reader: Optional[threading.Thread] = None
+        self._push_handlers: Dict[str, Callable[[Any], None]] = {}
+        self._closed = False
+
+    # -- connection management --
+
+    def connect(self) -> None:
+        with self._conn_lock:
+            if self._sock is not None:
+                return
+            deadline = time.monotonic() + config.rpc_connect_timeout_s
+            last_err: Optional[Exception] = None
+            while time.monotonic() < deadline:
+                try:
+                    sock = socket.create_connection(
+                        (self._host, self._port), timeout=config.rpc_connect_timeout_s
+                    )
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    sock.settimeout(None)
+                    self._sock = sock
+                    self._reader = threading.Thread(
+                        target=self._read_loop, name=f"{self.name}-read", daemon=True
+                    )
+                    self._reader.start()
+                    return
+                except OSError as e:
+                    last_err = e
+                    time.sleep(0.05)
+            raise RpcConnectionError(
+                f"cannot connect to {self.address}: {last_err}"
+            )
+
+    def close(self) -> None:
+        self._closed = True
+        with self._conn_lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def _read_loop(self) -> None:
+        sock = self._sock
+        try:
+            while True:
+                frame = _recv_frame(sock)
+                msg = serialization.loads(frame)
+                if msg[0] == "resp":
+                    _, req_id, ok, payload = msg
+                    with self._pending_lock:
+                        pending = self._pending.pop(req_id, None)
+                    if pending is not None:
+                        pending.set(ok, payload)
+                elif msg[0] == "push":
+                    _, topic, payload = msg
+                    handler = self._push_handlers.get(topic)
+                    if handler is not None:
+                        try:
+                            handler(payload)
+                        except Exception:
+                            logger.exception("push handler for %r failed", topic)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            err = RpcConnectionError(f"connection to {self.address} lost")
+            with self._pending_lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for p in pending:
+                p.set(False, err)
+            with self._conn_lock:
+                if self._sock is sock:
+                    self._sock = None
+
+    def on_push(self, topic: str, handler: Callable[[Any], None]) -> None:
+        self._push_handlers[topic] = handler
+
+    # -- calls --
+
+    def call(
+        self,
+        method: str,
+        *args,
+        timeout_s: Optional[float] = None,
+        retryable: bool = False,
+        **kwargs,
+    ) -> Any:
+        timeout_s = timeout_s if timeout_s is not None else config.rpc_request_timeout_s
+        attempts = 1 + (config.rpc_max_retries if retryable else 0)
+        last_err: Optional[Exception] = None
+        for attempt in range(attempts):
+            try:
+                maybe_inject_request_failure(method)
+                result = self._call_once(method, args, kwargs, timeout_s)
+                maybe_inject_response_failure(method)
+                return result
+            except (RpcConnectionError, RpcTimeout) as e:
+                last_err = e
+                if attempt + 1 < attempts and not self._closed:
+                    time.sleep(config.rpc_retry_delay_s * (2**attempt))
+                    continue
+                raise
+            except RemoteError:
+                raise
+        raise last_err  # pragma: no cover
+
+    def call_async(self, method: str, *args, **kwargs) -> "_PendingCall":
+        """Send a request now; wait for the reply later via handle.wait().
+
+        The frame is on the wire when this returns, so two call_async()s
+        made in order arrive at the server in order — the property actor
+        submission uses for per-caller ordered execution."""
+        sock = self._ensure_sock()
+        with self._pending_lock:
+            self._next_id += 1
+            req_id = self._next_id
+            pending = _PendingCall()
+            self._pending[req_id] = pending
+        payload = serialization.dumps(("req", req_id, method, args, kwargs))
+        try:
+            _send_frame(sock, payload, self._send_lock)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise RpcConnectionError(str(e)) from e
+        return pending
+
+    def _ensure_sock(self) -> socket.socket:
+        """Snapshot the socket — _read_loop may null self._sock at any
+        moment; operating on a local copy turns that race into an OSError
+        (mapped to RpcConnectionError) instead of an AttributeError."""
+        sock = self._sock
+        if sock is None:
+            self.connect()
+            sock = self._sock
+        if sock is None:
+            raise RpcConnectionError(f"connection to {self.address} lost")
+        return sock
+
+    def call_oneway(self, method: str, *args, **kwargs) -> None:
+        sock = self._ensure_sock()
+        payload = serialization.dumps(("req", None, method, args, kwargs))
+        try:
+            _send_frame(sock, payload, self._send_lock)
+        except OSError as e:
+            raise RpcConnectionError(str(e)) from e
+
+    def _call_once(self, method, args, kwargs, timeout_s) -> Any:
+        sock = self._ensure_sock()
+        with self._pending_lock:
+            self._next_id += 1
+            req_id = self._next_id
+            pending = _PendingCall()
+            self._pending[req_id] = pending
+        payload = serialization.dumps(("req", req_id, method, args, kwargs))
+        try:
+            _send_frame(sock, payload, self._send_lock)
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise RpcConnectionError(str(e)) from e
+        if not pending.event.wait(timeout_s):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise RpcTimeout(f"{method} on {self.address} timed out after {timeout_s}s")
+        if not pending.ok:
+            raise pending.payload
+        return pending.payload
+
+
+class _PendingCall:
+    __slots__ = ("event", "ok", "payload")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.ok = False
+        self.payload = None
+
+    def set(self, ok: bool, payload: Any) -> None:
+        self.ok = ok
+        self.payload = payload
+        self.event.set()
+
+    def wait(self, timeout_s: Optional[float] = None) -> Any:
+        if not self.event.wait(timeout_s):
+            raise RpcTimeout(f"call timed out after {timeout_s}s")
+        if not self.ok:
+            raise self.payload
+        return self.payload
+
+
+class ClientPool:
+    """Cache of RpcClients keyed by address (reference: client pools in
+    src/ray/rpc/)."""
+
+    def __init__(self, name: str = "pool"):
+        self._name = name
+        self._clients: Dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None:
+                client = RpcClient(address, name=f"{self._name}->{address}")
+                self._clients[address] = client
+            return client
+
+    def drop(self, address: str) -> None:
+        with self._lock:
+            client = self._clients.pop(address, None)
+        if client is not None:
+            client.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()

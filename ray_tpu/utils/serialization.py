@@ -1,0 +1,93 @@
+"""Serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Equivalent of the reference's SerializationContext
+(python/ray/_private/serialization.py:147): functions/classes go through
+cloudpickle; data goes through pickle protocol 5 with out-of-band buffers so
+large numpy arrays are written/read zero-copy against the shared-memory
+object store.
+
+Wire format of a serialized object:
+  meta:    pickled bytes (with PickleBuffer placeholders)
+  buffers: list of raw buffers, referenced in order by the meta stream
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Sequence, Tuple
+
+import cloudpickle
+
+PROTOCOL = 5
+
+
+def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
+    """Serialize to (meta, out-of-band buffers). Buffers are zero-copy views."""
+    buffers: List[pickle.PickleBuffer] = []
+    try:
+        meta = pickle.dumps(obj, protocol=PROTOCOL, buffer_callback=buffers.append)
+    except Exception:
+        # Fallback for closures, lambdas, locally-defined classes.
+        buffers = []
+        meta = cloudpickle.dumps(obj, protocol=PROTOCOL, buffer_callback=buffers.append)
+    views = [b.raw() for b in buffers]
+    return meta, views
+
+
+def deserialize(meta: bytes, buffers: Sequence[Any]) -> Any:
+    return pickle.loads(meta, buffers=buffers)
+
+
+def dumps(obj: Any) -> bytes:
+    """One-shot in-band serialization (control-plane messages)."""
+    try:
+        return pickle.dumps(obj, protocol=PROTOCOL)
+    except Exception:
+        return cloudpickle.dumps(obj, protocol=PROTOCOL)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)
+
+
+def dumps_function(fn: Any) -> bytes:
+    """Serialize a function/class definition (always cloudpickle)."""
+    return cloudpickle.dumps(fn, protocol=PROTOCOL)
+
+
+def pack(obj: Any) -> bytes:
+    """Serialize obj into a single contiguous frame: header + meta + buffers.
+
+    Layout: [n_bufs u32][meta_len u64][buf_len u64 * n_bufs][meta][bufs...]
+    Used when an object must travel as one blob (shm store, network).
+    """
+    meta, views = serialize(obj)
+    parts = [
+        len(views).to_bytes(4, "little"),
+        len(meta).to_bytes(8, "little"),
+    ]
+    for v in views:
+        parts.append(v.nbytes.to_bytes(8, "little"))
+    parts.append(meta)
+    parts.extend(views)
+    return b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts)
+
+
+def unpack(frame) -> Any:
+    """Inverse of pack(). Accepts bytes or memoryview; buffers stay zero-copy
+    views into the frame (caller keeps the frame alive, e.g. shm mapping)."""
+    mv = memoryview(frame)
+    n_bufs = int.from_bytes(mv[0:4], "little")
+    meta_len = int.from_bytes(mv[4:12], "little")
+    off = 12
+    buf_lens = []
+    for _ in range(n_bufs):
+        buf_lens.append(int.from_bytes(mv[off : off + 8], "little"))
+        off += 8
+    meta = bytes(mv[off : off + meta_len])
+    off += meta_len
+    buffers = []
+    for ln in buf_lens:
+        buffers.append(mv[off : off + ln])
+        off += ln
+    return deserialize(meta, buffers)

@@ -1,0 +1,149 @@
+"""Actor tests (parity model: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+    def boom(self):
+        raise RuntimeError("actor method failed")
+
+
+def test_actor_basic(rt):
+    c = Counter.remote(10)
+    assert rt.get(c.incr.remote()) == 11
+    assert rt.get(c.incr.remote(5)) == 16
+    assert rt.get(c.get.remote()) == 16
+
+
+def test_actor_ordered_execution(rt):
+    c = Counter.remote(0)
+    refs = [c.incr.remote() for _ in range(50)]
+    # per-caller ordering: results must be 1..50 in submission order
+    assert rt.get(refs) == list(range(1, 51))
+
+
+def test_actor_method_exception(rt):
+    c = Counter.remote()
+    with pytest.raises(ray_tpu.exceptions.TaskError, match="actor method failed"):
+        rt.get(c.boom.remote())
+    # actor survives a method exception
+    assert rt.get(c.incr.remote()) == 1
+
+
+def test_two_actors_isolated(rt):
+    a = Counter.remote(0)
+    b = Counter.remote(100)
+    rt.get([a.incr.remote(), b.incr.remote()])
+    assert rt.get(a.get.remote()) == 1
+    assert rt.get(b.get.remote()) == 101
+
+
+def test_named_actor(rt):
+    c = Counter.options(name="global_counter").remote(7)
+    rt.get(c.get.remote())  # ensure alive
+    h = rt.get_actor("global_counter")
+    assert rt.get(h.get.remote()) == 7
+    # duplicate name rejected
+    with pytest.raises(Exception, match="already taken"):
+        Counter.options(name="global_counter").remote()
+
+
+def test_actor_handle_passed_to_task(rt):
+    c = Counter.remote(0)
+
+    @rt.remote
+    def bump(handle, n):
+        import ray_tpu as rt2
+
+        return rt2.get(handle.incr.remote(n))
+
+    assert rt.get(bump.remote(c, 5)) == 5
+    assert rt.get(c.get.remote()) == 5
+
+
+def test_kill_actor(rt):
+    c = Counter.remote(0)
+    rt.get(c.get.remote())
+    rt.kill(c)
+    with pytest.raises(
+        (ray_tpu.exceptions.ActorDiedError, ray_tpu.exceptions.TaskError)
+    ):
+        rt.get(c.get.remote(), timeout=30)
+
+
+def test_actor_restart_on_crash(rt):
+    @rt.remote
+    class Flaky:
+        def __init__(self):
+            self.count = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+    f = Flaky.options(max_restarts=1).remote()
+    pid1 = rt.get(f.pid.remote())
+    try:
+        rt.get(f.crash.remote(), timeout=30)
+    except Exception:
+        pass
+    # actor restarts on a fresh worker
+    deadline = time.monotonic() + 30
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = rt.get(f.pid.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_max_concurrency(rt):
+    @rt.remote
+    class Slow:
+        def work(self):
+            import time as t
+
+            t.sleep(0.4)
+            return 1
+
+    s = Slow.options(max_concurrency=4).remote()
+    start = time.monotonic()
+    rt.get([s.work.remote() for _ in range(4)])
+    assert time.monotonic() - start < 1.3  # overlapped, not 1.6s serial
+
+
+def test_detached_lifetime_field(rt):
+    c = Counter.options(name="det", lifetime="detached").remote()
+    rt.get(c.get.remote())
+    info = rt.get_actor("det")
+    assert info is not None

@@ -1,0 +1,67 @@
+"""Failure-semantics regression tests (bugs found in round-1 review)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_actor_init_failure_surfaces(rt):
+    """A failing __init__ must mark the actor DEAD with the cause — not
+    retry forever while callers hang."""
+
+    @rt.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("constructor exploded")
+
+        def ping(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError, match="constructor exploded"):
+        rt.get(b.ping.remote(), timeout=60)
+
+
+def test_cancel_queued_task(rt):
+    """Cancelling a task stuck behind busy resources stores
+    TaskCancelledError instead of running it."""
+
+    @rt.remote
+    def hog():
+        time.sleep(3)
+        return "hog"
+
+    @rt.remote
+    def victim():
+        return "ran"
+
+    hogs = [hog.remote() for _ in range(4)]  # saturate 4 CPUs
+    time.sleep(0.3)
+    v = victim.remote()  # queued behind the hogs
+    rt.cancel(v)
+    with pytest.raises(ray_tpu.exceptions.TaskCancelledError):
+        rt.get(v, timeout=30)
+    rt.get(hogs)  # drain
+
+
+def test_escaped_ref_survives_local_del(rt):
+    """A ref serialized into task args must pin the object even if the
+    caller drops its local reference before the task runs."""
+
+    @rt.remote
+    def reader(x):
+        return x + 1
+
+    ref = rt.put(41)
+    out = reader.remote(ref)
+    del ref  # owner-local count -> 0, but the ref escaped into args
+    assert rt.get(out, timeout=30) == 42

@@ -1,0 +1,35 @@
+from ray_tpu.utils.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+
+
+def test_lineage_embedding():
+    job = JobID.from_int(7)
+    task = TaskID.for_normal_task(job)
+    assert task.job_id() == job
+    assert not task.is_actor_task()
+
+    actor = ActorID.of(job)
+    atask = TaskID.for_actor_task(actor)
+    assert atask.is_actor_task()
+    assert atask.actor_id() == actor
+    assert atask.job_id() == job
+
+    obj = ObjectID.from_task(task, 3)
+    assert obj.task_id() == task
+    assert obj.index() == 3
+    assert obj.job_id() == job
+
+
+def test_roundtrip_and_equality():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n
+    assert hash(NodeID.from_hex(n.hex())) == hash(n)
+    assert n != NodeID.from_random()
+    assert NodeID.nil().is_nil()
+    assert not n.is_nil()
+
+
+def test_pickle_roundtrip():
+    import pickle
+
+    obj = ObjectID.from_task(TaskID.for_normal_task(JobID.from_int(1)), 0)
+    assert pickle.loads(pickle.dumps(obj)) == obj

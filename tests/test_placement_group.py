@@ -1,0 +1,93 @@
+"""Placement group tests (parity model: python/ray/tests/test_placement_group*.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.placement import PlacementGroupSchedulingStrategy
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_pg_create_and_ready(rt):
+    pg = rt.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(timeout_seconds=10)
+    table = pg.table()
+    assert table["state"] == "CREATED"
+    assert len(table["bundle_locations"]) == 2
+    rt.remove_placement_group(pg)
+
+
+def test_pg_ready_objectref(rt):
+    pg = rt.placement_group([{"CPU": 1}], strategy="PACK")
+    got = rt.get(pg.ready(), timeout=10)
+    assert got.id_hex == pg.id_hex
+    rt.remove_placement_group(pg)
+
+
+def test_pg_infeasible_stays_pending(rt):
+    pg = rt.placement_group([{"CPU": 512}], strategy="STRICT_PACK")
+    assert not pg.wait(timeout_seconds=1.0)
+    rt.remove_placement_group(pg)
+
+
+def test_task_in_pg_bundle(rt):
+    pg = rt.placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(10)
+
+    @rt.remote
+    def where():
+        import ray_tpu as rt2
+
+        return rt2.get_runtime_context().get_node_id()
+
+    strategy = PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    node = rt.get(where.options(scheduling_strategy=strategy).remote())
+    assert node == pg.table()["bundle_locations"][0]
+    rt.remove_placement_group(pg)
+
+
+def test_actor_in_pg(rt):
+    pg = rt.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+
+    @rt.remote
+    class A:
+        def node(self):
+            import ray_tpu as rt2
+
+            return rt2.get_runtime_context().get_node_id()
+
+    a = A.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)
+    ).remote()
+    assert rt.get(a.node.remote()) == pg.table()["bundle_locations"][0]
+    rt.kill(a)
+    rt.remove_placement_group(pg)
+
+
+def test_pg_resources_released_on_remove(rt):
+    from ray_tpu.core.api import available_resources
+    import time
+
+    before = available_resources().get("CPU", 0)
+    pg = rt.placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(10)
+    rt.remove_placement_group(pg)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if available_resources().get("CPU", 0) >= before:
+            return
+        time.sleep(0.2)
+    raise AssertionError("CPU not released after remove_placement_group")
+
+
+def test_pg_strategy_validation(rt):
+    with pytest.raises(ValueError):
+        rt.placement_group([{"CPU": 1}], strategy="BOGUS")
+    with pytest.raises(ValueError):
+        rt.placement_group([], strategy="PACK")

@@ -1,0 +1,119 @@
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.utils import serialization
+from ray_tpu.utils.config import config
+from ray_tpu.utils.rpc import (
+    ClientPool,
+    RemoteError,
+    RpcClient,
+    RpcConnectionError,
+    RpcServer,
+)
+
+
+@pytest.fixture
+def server():
+    s = RpcServer("test")
+    s.register("echo", lambda conn, x: x)
+    s.register("add", lambda conn, a, b: a + b)
+    s.register("boom", lambda conn: 1 / 0)
+    s.register("slow", lambda conn, t: time.sleep(t))
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_basic_call(server):
+    c = RpcClient(server.address)
+    assert c.call("add", 2, 3) == 5
+    assert c.call("echo", {"k": [1, 2]}) == {"k": [1, 2]}
+    c.close()
+
+
+def test_remote_exception(server):
+    c = RpcClient(server.address)
+    with pytest.raises(RemoteError, match="ZeroDivisionError"):
+        c.call("boom")
+    c.close()
+
+
+def test_concurrent_calls(server):
+    c = RpcClient(server.address)
+    results = {}
+
+    def worker(i):
+        results[i] = c.call("add", i, i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(20)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == {i: 2 * i for i in range(20)}
+    c.close()
+
+
+def test_push(server):
+    got = []
+    event = threading.Event()
+
+    def handler(conn):
+        conn.push("news", "hello")
+        return True
+
+    server.register("trigger", handler)
+    c = RpcClient(server.address)
+    c.on_push("news", lambda payload: (got.append(payload), event.set()))
+    assert c.call("trigger")
+    assert event.wait(5)
+    assert got == ["hello"]
+    c.close()
+
+
+def test_connect_failure_fast():
+    c = RpcClient("127.0.0.1:1")  # nothing listens there
+    old = config.rpc_connect_timeout_s
+    config.set("rpc_connect_timeout_s", 0.3)
+    try:
+        with pytest.raises(RpcConnectionError):
+            c.call("echo", 1)
+    finally:
+        config.set("rpc_connect_timeout_s", old)
+
+
+def test_chaos_injection(server):
+    config.set("testing_rpc_failure", "echo:1.0:0.0")
+    try:
+        c = RpcClient(server.address)
+        with pytest.raises(RpcConnectionError, match="chaos"):
+            c.call("echo", 1, retryable=False)
+    finally:
+        config.set("testing_rpc_failure", "")
+        c.close()
+
+
+def test_client_pool(server):
+    pool = ClientPool()
+    c1 = pool.get(server.address)
+    c2 = pool.get(server.address)
+    assert c1 is c2
+    assert c1.call("add", 1, 1) == 2
+    pool.close_all()
+
+
+def test_serialization_zero_copy_roundtrip():
+    arr = np.arange(1 << 16, dtype=np.float32).reshape(256, 256)
+    frame = serialization.pack({"x": arr, "tag": "t"})
+    out = serialization.unpack(frame)
+    assert out["tag"] == "t"
+    np.testing.assert_array_equal(out["x"], arr)
+
+
+def test_serialization_closure():
+    y = 10
+    frame = serialization.pack(lambda x: x + y)
+    assert serialization.unpack(frame)(5) == 15
