@@ -1,0 +1,101 @@
+"""Headline benchmark: GPT-2 training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "gpt2_train_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/s/chip", "vs_baseline": N, ...}
+
+vs_baseline is measured MFU / 0.40 — the reference publishes no tokens/sec
+(BASELINE.md: `published` empty), so the baseline is the 40% MFU an
+efficient DDP/NCCL GPT-2 pretrain typically sustains (BASELINE.json north
+star: ≥90% of Ray-on-NCCL scaling efficiency). vs_baseline ≥ 1.0 means we
+meet/beat that bar on the one chip the harness provides.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _peak_flops_per_chip() -> float:
+    """bf16 peak for the local chip generation."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    return 100e12  # unknown / CPU fallback, value only used for vs_baseline
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import gpt2
+
+    import dataclasses
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        cfg = dataclasses.replace(
+            gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=True
+        )
+        batch, seq, steps = 32, 1024, 10
+    else:  # CI smoke mode
+        cfg = gpt2.CONFIGS["gpt2-tiny"]
+        batch, seq, steps = 8, 64, 3
+
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, dtype="int32"
+    )
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+
+    # warmup / compile (float() forces a device sync — block_until_ready
+    # alone does not drain the axon remote-execution tunnel)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    flops_per_token = 6.0 * n_params
+    mfu = tokens_per_sec * flops_per_token / _peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "gpt2_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "model": "gpt2-small" if on_tpu else "gpt2-tiny",
+            "params": int(n_params),
+            "batch": batch,
+            "seq": seq,
+            "steps": steps,
+            "loss": round(float(loss), 4),
+            "mfu": round(mfu, 4),
+            "backend": jax.default_backend(),
+            "device": jax.devices()[0].device_kind,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""Collective communication library for actors.
+
+Parity: ray.util.collective (reference python/ray/util/collective/
+collective.py — init_collective_group :171, create_collective_group :211,
+allreduce/reduce/broadcast/allgather/reducescatter/send/recv :328-724;
+backends NCCL/GLOO types.py:34-48).
+
+TPU-first backend mapping (SURVEY.md §2.4 "Collective backend"):
+  - device collectives are IN-GRAPH XLA ops over a mesh — the framework's
+    main compute path never calls this library on device tensors;
+  - "cpu" backend here fills the Gloo role: host-tensor collectives
+    between actors, rendezvoused and exchanged through the control store
+    KV (the reference rendezvouses NCCLUniqueID through a named store
+    actor the same way, nccl_collective_group.py:29-60);
+  - "xla" groups bootstrap jax.distributed for multi-host device meshes:
+    declare_xla_group/get_xla_coordinator hand out the coordinator
+    address through the control store KV so every member can call
+    jax.distributed.initialize and then build a global mesh.
+"""
+
+from ray_tpu.collective.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.xla_group import get_xla_coordinator, xla_coordinator_env
+
+__all__ = [
+    "ReduceOp",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "destroy_collective_group",
+    "get_collective_group_size",
+    "get_rank",
+    "get_xla_coordinator",
+    "init_collective_group",
+    "recv",
+    "reducescatter",
+    "send",
+    "xla_coordinator_env",
+]

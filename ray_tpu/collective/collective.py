@@ -1,0 +1,225 @@
+"""Host-tensor collectives over the control-store KV (the Gloo role).
+
+Algorithm: each op gets a (group, seq) namespace; every rank publishes its
+contribution and polls for peers', then reduces locally — correct and
+dependency-free, O(n²) traffic, intended for small host tensors
+(rendezvous payloads, metrics, gradients of toy models in CI). Device
+tensors should use in-graph mesh collectives instead.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.utils import serialization
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: sum(xs[1:], xs[0].copy()),
+    ReduceOp.PRODUCT: lambda xs: np.prod(np.stack(xs), axis=0),
+    ReduceOp.MIN: lambda xs: np.min(np.stack(xs), axis=0),
+    ReduceOp.MAX: lambda xs: np.max(np.stack(xs), axis=0),
+}
+
+
+class _GroupState:
+    def __init__(self, group_name: str, world_size: int, rank: int):
+        self.name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.seq = 0
+        # p2p streams get their own per-(src,dst) counters: collective seq
+        # numbers only align across ranks when every rank runs every op.
+        self.p2p_counts: Dict[tuple, int] = {}
+        # my published keys, deleted with a 2-op lag (peers of op N have
+        # all read it once op N+2 starts — bounds control-store memory)
+        self.gc_queue: List[str] = []
+        self.lock = threading.Lock()
+
+
+_groups: Dict[str, _GroupState] = {}
+
+
+def _control():
+    from ray_tpu.core import worker as worker_mod
+
+    return worker_mod.global_worker().control
+
+
+def _ns(group: _GroupState) -> str:
+    return f"coll/{group.name}"
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "cpu",
+    group_name: str = "default",
+) -> None:
+    """Register this process as `rank` of a collective group.
+
+    Called by every participating actor/task (parity: collective.py:171).
+    """
+    if backend not in ("cpu", "xla"):
+        raise ValueError(f"unsupported backend {backend!r}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    _groups[group_name] = _GroupState(group_name, world_size, rank)
+    # rendezvous barrier so all members see each other before first op
+    barrier(group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Drop group state and delete its KV namespace (required before a
+    group name can be REUSED — stale keys from a prior incarnation would
+    otherwise satisfy the new group's rendezvous)."""
+    group = _groups.pop(group_name, None)
+    try:
+        _control().call_oneway("kv_del_prefix", ns=f"coll/{group_name}", prefix="")
+    except Exception:  # noqa: BLE001 — cluster may already be down
+        pass
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _groups[group_name].world_size
+
+
+def _exchange(group: _GroupState, payload: Optional[bytes], tag: str,
+              ranks: Optional[List[int]] = None,
+              timeout_s: float = 120.0, gc: bool = True) -> Dict[int, bytes]:
+    """Publish payload under (tag, my rank); collect peers' payloads.
+
+    gc=True is only valid for full-participation ops (every rank publishes
+    and reads every other): completing op N+1 then proves all peers read
+    op N-1's keys, so each rank deletes its own keys with a 2-op lag.
+    Broadcast/p2p keys are exempt (the publisher can finish before readers
+    arrive) and are reclaimed by destroy_collective_group().
+    """
+    control = _control()
+    ns = _ns(group)
+    if payload is not None:
+        control.call(
+            "kv_put", ns=ns, key=f"{tag}/{group.rank}", value=payload,
+            retryable=True,
+        )
+    if payload is not None and gc:
+        with group.lock:
+            group.gc_queue.append(f"{tag}/{group.rank}")
+            stale = group.gc_queue[:-2]
+            group.gc_queue = group.gc_queue[-2:]
+        for key in stale:
+            try:
+                control.call_oneway("kv_del", ns=ns, key=key)
+            except Exception:  # noqa: BLE001
+                pass
+    want = ranks if ranks is not None else list(range(group.world_size))
+    out: Dict[int, bytes] = {}
+    deadline = time.monotonic() + timeout_s
+    poll = 0.002
+    while len(out) < len(want):
+        for r in want:
+            if r in out:
+                continue
+            val = control.call("kv_get", ns=ns, key=f"{tag}/{r}", retryable=True)
+            if val is not None:
+                out[r] = val
+        if len(out) < len(want):
+            if time.monotonic() > deadline:
+                missing = [r for r in want if r not in out]
+                raise TimeoutError(
+                    f"collective {tag} on group {group.name}: ranks {missing} "
+                    f"missing after {timeout_s}s"
+                )
+            time.sleep(poll)
+            poll = min(poll * 1.5, 0.1)
+    return out
+
+
+def _next_tag(group: _GroupState, op: str) -> str:
+    with group.lock:
+        group.seq += 1
+        return f"{op}/{group.seq}"
+
+
+def allreduce(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
+    group = _groups[group_name]
+    arr = np.asarray(tensor)
+    tag = _next_tag(group, "allreduce")
+    parts = _exchange(group, serialization.pack(arr), tag)
+    arrays = [serialization.unpack(parts[r]) for r in sorted(parts)]
+    return _REDUCERS[op](arrays)
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    group = _groups[group_name]
+    tag = _next_tag(group, "allgather")
+    parts = _exchange(group, serialization.pack(np.asarray(tensor)), tag)
+    return [serialization.unpack(parts[r]) for r in sorted(parts)]
+
+
+def reducescatter(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
+    """Reduce across ranks, return this rank's 1/world_size slice (dim 0)."""
+    group = _groups[group_name]
+    arr = np.asarray(tensor)
+    if arr.shape[0] % group.world_size != 0:
+        raise ValueError(
+            f"dim 0 ({arr.shape[0]}) not divisible by world size "
+            f"{group.world_size}"
+        )
+    reduced = allreduce(arr, op, group_name)
+    chunk = reduced.shape[0] // group.world_size
+    return reduced[group.rank * chunk : (group.rank + 1) * chunk]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    group = _groups[group_name]
+    tag = _next_tag(group, "broadcast")
+    payload = (
+        serialization.pack(np.asarray(tensor)) if group.rank == src_rank else None
+    )
+    parts = _exchange(group, payload, tag, ranks=[src_rank], gc=False)
+    return serialization.unpack(parts[src_rank])
+
+
+def barrier(group_name: str = "default") -> None:
+    group = _groups[group_name]
+    tag = _next_tag(group, "barrier")
+    _exchange(group, b"1", tag)
+
+
+def _p2p_tag(group: _GroupState, src: int, dst: int) -> str:
+    with group.lock:
+        n = group.p2p_counts.get((src, dst), 0) + 1
+        group.p2p_counts[(src, dst)] = n
+        return f"p2p/{src}/{dst}/{n}"
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    group = _groups[group_name]
+    tag = _p2p_tag(group, group.rank, dst_rank)
+    _control().call(
+        "kv_put", ns=_ns(group), key=f"{tag}/{group.rank}",
+        value=serialization.pack(np.asarray(tensor)), retryable=True,
+    )
+
+
+def recv(src_rank: int, group_name: str = "default", timeout_s: float = 120.0):
+    group = _groups[group_name]
+    tag = _p2p_tag(group, src_rank, group.rank)
+    parts = _exchange(group, None, tag, ranks=[src_rank], timeout_s=timeout_s)
+    return serialization.unpack(parts[src_rank])

@@ -1,0 +1,88 @@
+"""XLA device-mesh group bootstrap: jax.distributed coordination via the
+control store.
+
+Role parity: where the reference rendezvouses an NCCLUniqueID through a
+named store actor (nccl_collective_group.py:29-60), a TPU group
+rendezvouses the jax.distributed coordinator address through the control
+store KV. After initialize_xla_group() every member process is part of one
+JAX runtime; device collectives are then ordinary in-graph mesh ops.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Dict, Optional
+
+
+def _control():
+    from ray_tpu.core import worker as worker_mod
+
+    return worker_mod.global_worker().control
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def get_xla_coordinator(group_name: str, rank: int, timeout_s: float = 60.0) -> str:
+    """Rank 0 claims (or reuses) the coordinator address; others poll it."""
+    control = _control()
+    key = f"xla/{group_name}/coordinator"
+    if rank == 0:
+        addr = f"{socket.gethostbyname(socket.gethostname())}:{_free_port()}"
+        if not control.call("kv_put", ns="coll", key=key, value=addr.encode(),
+                            overwrite=False, retryable=True):
+            addr = control.call("kv_get", ns="coll", key=key, retryable=True).decode()
+        return addr
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        val = control.call("kv_get", ns="coll", key=key, retryable=True)
+        if val is not None:
+            return val.decode()
+        time.sleep(0.05)
+    raise TimeoutError(f"no coordinator for xla group {group_name}")
+
+
+def xla_coordinator_env(
+    group_name: str,
+    rank: int,
+    world_size: int,
+    num_slices: int = 1,
+    slice_id: int = 0,
+) -> Dict[str, str]:
+    """Env for a worker joining the group's JAX runtime; includes the
+    MEGASCALE multislice variables when num_slices > 1 (parity:
+    train/v2/jax/config.py:113-165 + util/tpu.py:198)."""
+    coordinator = get_xla_coordinator(group_name, rank)
+    env = {
+        "JAX_COORDINATOR_ADDRESS": coordinator,
+        "JAX_NUM_PROCESSES": str(world_size),
+        "JAX_PROCESS_ID": str(rank),
+    }
+    if num_slices > 1:
+        from ray_tpu.accelerators.tpu import get_tpu_coordinator_env_vars
+
+        env.update(
+            get_tpu_coordinator_env_vars(coordinator, num_slices, slice_id)
+        )
+    return env
+
+
+def initialize_xla_group(
+    group_name: str, rank: int, world_size: int
+) -> None:
+    """Join this process into the group's JAX runtime
+    (jax.distributed.initialize with control-store rendezvous)."""
+    import jax
+
+    coordinator = get_xla_coordinator(group_name, rank)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=world_size,
+        process_id=rank,
+    )

@@ -141,6 +141,18 @@ class ControlStore:
         with self._lock:
             return [k for k in self._kv.get(ns, {}) if k.startswith(prefix)]
 
+    def rpc_kv_del_prefix(self, conn, ns: str, prefix: str = ""):
+        with self._lock:
+            table = self._kv.get(ns)
+            if table is None:
+                return 0
+            doomed = [k for k in table if k.startswith(prefix)]
+            for k in doomed:
+                del table[k]
+            if not table and prefix == "":
+                self._kv.pop(ns, None)
+            return len(doomed)
+
     # ------------------------------------------------------------------
     # nodes (reference GcsNodeManager + health checks + syncer)
     # ------------------------------------------------------------------

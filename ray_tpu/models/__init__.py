@@ -1,0 +1,1 @@
+"""Model zoo: pure-JAX pytree models with GSPMD sharding annotations."""

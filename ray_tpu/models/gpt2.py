@@ -1,0 +1,210 @@
+"""GPT-2 — the flagship model (BASELINE.md configs 1/3: GPT-2 pretrain).
+
+Pure-JAX pytree implementation, TPU-first:
+  - layers STACKED on a leading L dim and iterated with lax.scan → one
+    compiled block body instead of L unrolled copies (fast compile, XLA
+    pipelines the loop);
+  - fused QKV projection, single (D, 3, H, Dh) matmul feeding the MXU;
+  - vocab padded to a multiple of 128 (MXU lane width);
+  - bf16 compute / fp32 master params; logits + softmax in fp32;
+  - jax.checkpoint (remat) around each block to trade FLOPs for HBM;
+  - GSPMD sharding via parallel.sharding.gpt_rules: TP on heads/hidden,
+    FSDP on the complementary dim, batch over dp axes, sequence over cp.
+
+The weights are compatible in spirit (same architecture: pre-LN, learned
+positions, GELU, tied LM head) with the reference's GPT-2 configs used by
+its Train benchmarks (reference release/train_tests/benchmark).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention as attention_op
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dtype: Any = jnp.bfloat16  # compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    # "full" recomputes the whole block; "dots" saves matmul outputs and
+    # recomputes only cheap elementwise ops (less recompute, more HBM)
+    remat_policy: str = "full"
+    attn_impl: str = "reference"  # reference | flash | ring
+    cp_axis: Optional[str] = None  # mesh axis name when attn_impl="ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def padded_vocab(self) -> int:
+        return ((self.vocab_size + 127) // 128) * 128
+
+    @property
+    def d_ff(self) -> int:
+        return 4 * self.d_model
+
+    def num_params(self) -> int:
+        d, l, v = self.d_model, self.n_layer, self.padded_vocab
+        per_layer = 4 * d * d + 2 * 4 * d * d + 3 * d + 4 * d + 2 * 2 * d + d
+        return v * d + self.n_positions * d + l * per_layer + 2 * d
+
+
+# Reference configs (model sizes the reference benchmarks use)
+CONFIGS = {
+    "gpt2-small": GPT2Config(),
+    "gpt2-medium": GPT2Config(d_model=1024, n_layer=24, n_head=16),
+    "gpt2-large": GPT2Config(d_model=1280, n_layer=36, n_head=20),
+    "gpt2-xl": GPT2Config(d_model=1600, n_layer=48, n_head=25),
+    "gpt2-tiny": GPT2Config(  # tests / dryruns
+        vocab_size=256, n_positions=128, d_model=64, n_layer=2, n_head=4,
+        remat=False,
+    ),
+}
+
+
+def init(rng: jax.Array, cfg: GPT2Config) -> Dict[str, Any]:
+    """Initialize the parameter pytree (stacked-layer layout)."""
+    d, l, h, hd, f = cfg.d_model, cfg.n_layer, cfg.n_head, cfg.head_dim, cfg.d_ff
+    v, t = cfg.padded_vocab, cfg.n_positions
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    proj_std = std / math.sqrt(2 * l)  # GPT-2 residual-scale init
+    pd = cfg.param_dtype
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(pd)
+
+    return {
+        "wte": norm(next(k), (v, d), std),
+        "wpe": norm(next(k), (t, d), std),
+        "blocks": {
+            "ln1": {"scale": jnp.ones((l, d), pd), "bias": jnp.zeros((l, d), pd)},
+            "ln2": {"scale": jnp.ones((l, d), pd), "bias": jnp.zeros((l, d), pd)},
+            "attn": {
+                "qkv": {
+                    "kernel": norm(next(k), (l, d, 3, h, hd), std),
+                    "bias": jnp.zeros((l, 3, h, hd), pd),
+                },
+                "proj": {
+                    "kernel": norm(next(k), (l, h, hd, d), proj_std),
+                    "bias": jnp.zeros((l, d), pd),
+                },
+            },
+            "mlp": {
+                "fc_in": {
+                    "kernel": norm(next(k), (l, d, f), std),
+                    "bias": jnp.zeros((l, f), pd),
+                },
+                "fc_out": {
+                    "kernel": norm(next(k), (l, f, d), proj_std),
+                    "bias": jnp.zeros((l, d), pd),
+                },
+            },
+        },
+        "ln_f": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+    }
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(x, layer, cfg: GPT2Config):
+    """One pre-LN transformer block (body of the layer scan)."""
+    dt = cfg.dtype
+    h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+    qkv = (
+        jnp.einsum("btd,dchn->btchn", h, layer["attn"]["qkv"]["kernel"].astype(dt))
+        + layer["attn"]["qkv"]["bias"].astype(dt)
+    )
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H,Dh]
+    att = attention_op(
+        q, k, v, causal=True, impl=cfg.attn_impl, axis_name=cfg.cp_axis
+    )
+    att = (
+        jnp.einsum("bthn,hnd->btd", att, layer["attn"]["proj"]["kernel"].astype(dt))
+        + layer["attn"]["proj"]["bias"].astype(dt)
+    )
+    x = x + att
+    h = _layernorm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
+    h = (
+        jnp.einsum("btd,df->btf", h, layer["mlp"]["fc_in"]["kernel"].astype(dt))
+        + layer["mlp"]["fc_in"]["bias"].astype(dt)
+    )
+    h = jax.nn.gelu(h, approximate=True)
+    h = (
+        jnp.einsum("btf,fd->btd", h, layer["mlp"]["fc_out"]["kernel"].astype(dt))
+        + layer["mlp"]["fc_out"]["bias"].astype(dt)
+    )
+    return x + h
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, padded_vocab] (fp32)."""
+    B, T = tokens.shape
+    dt = cfg.dtype
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:T][None]
+
+    def body(carry, layer):
+        return _block(carry, layer, cfg), None
+
+    if cfg.remat:
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    # tied LM head: bf16 operands on the MXU, fp32 accumulation → fp32
+    # logits for a stable softmax without paying the 8x fp32-matmul tax
+    return jnp.einsum(
+        "btd,vd->btv", x.astype(dt), params["wte"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def loss_fn(params, tokens, cfg: GPT2Config) -> jax.Array:
+    """Next-token cross-entropy; masks padded-vocab logits."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    if cfg.padded_vocab != cfg.vocab_size:
+        pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
+        logits = jnp.where(pad_mask[None, None], -1e30, logits)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: GPT2Config, optimizer):
+    """Returns train_step(params, opt_state, tokens) -> (params, opt_state, loss).
+
+    Pure function of pytrees: jit it with shardings from
+    parallel.sharding.gpt_rules over any mesh (dp/fsdp/tp/cp) — XLA
+    inserts the gradient psum over data axes from the shardings alone.
+    """
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: (p + u).astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return train_step

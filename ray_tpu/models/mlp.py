@@ -1,0 +1,56 @@
+"""MLP classifier — BASELINE.md config 1 (Fashion-MNIST MLP, the
+reference's PR1 Train example, python/ray/train test fixtures)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (128, 128)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def init(rng: jax.Array, cfg: MLPConfig) -> Dict[str, Any]:
+    dims = [cfg.in_dim, *cfg.hidden, cfg.num_classes]
+    keys = jax.random.split(rng, len(dims) - 1)
+    return {
+        f"layer{i}": {
+            "kernel": (
+                jax.random.normal(keys[i], (dims[i], dims[i + 1]))
+                * (2.0 / dims[i]) ** 0.5
+            ).astype(cfg.dtype),
+            "bias": jnp.zeros((dims[i + 1],), cfg.dtype),
+        }
+        for i in range(len(dims) - 1)
+    }
+
+
+def forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    n = len(params)
+    for i in range(n):
+        layer = params[f"layer{i}"]
+        x = x @ layer["kernel"] + layer["bias"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params, batch) -> jax.Array:
+    x, y = batch
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+    return nll.mean()
+
+
+def accuracy(params, batch) -> jax.Array:
+    x, y = batch
+    return (forward(params, x).argmax(-1) == y).mean()

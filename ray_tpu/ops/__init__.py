@@ -1,0 +1,6 @@
+"""TPU compute ops: attention implementations (reference, Pallas flash,
+ring/context-parallel) and kernel utilities."""
+
+from ray_tpu.ops.attention import attention
+
+__all__ = ["attention"]

@@ -1,0 +1,55 @@
+"""Attention implementations.
+
+impl="reference": readable jnp einsum attention (numerics oracle for tests).
+impl="flash":     Pallas TPU kernel (ray_tpu.ops.flash_attention) — tiled
+                  online-softmax so the T x T score matrix never hits HBM.
+impl="ring":      blockwise ring attention over the mesh "cp" axis
+                  (ray_tpu.ops.ring_attention) for sequence lengths that
+                  don't fit one chip. Absent from the reference entirely
+                  (SURVEY.md §5 "long-context"): it delegates long-sequence
+                  scaling to vLLM/DeepSpeed; here it is native.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def attention(
+    q: jax.Array,  # [B, T, H, Dh]
+    k: jax.Array,  # [B, S, H, Dh]
+    v: jax.Array,  # [B, S, H, Dh]
+    causal: bool = True,
+    impl: str = "reference",
+    axis_name: Optional[str] = None,  # mesh axis for impl="ring"
+) -> jax.Array:
+    if impl == "reference":
+        return _reference_attention(q, k, v, causal)
+    if impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, axis_name=axis_name or "cp", causal=causal)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def _reference_attention(q, k, v, causal):
+    *_, T, _, d = q.shape
+    S = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    # [B, H, T, S]; bf16 operands, fp32 accumulation on the MXU
+    scores = (
+        jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    if causal:
+        mask = jnp.tril(jnp.ones((T, S), dtype=bool), k=S - T)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)

@@ -1,0 +1,230 @@
+"""Flash attention — Pallas TPU kernel with custom VJP.
+
+The hot op of the transformer stack (no reference equivalent: the
+reference delegates attention math to torch/vLLM; SURVEY.md §2.4). Design
+for the TPU memory hierarchy (pallas_guide.md): the [T, S] score matrix
+lives only in VMEM — queries are tiled over the grid, K/V rows for one
+(batch, head) are resident in VMEM (T·Dh·2B each, ≈128KB at T=1024 —
+far under the ~16MB budget), and matmuls hit the MXU with fp32
+accumulation. This removes the O(B·H·T²) HBM traffic that makes the
+einsum reference implementation bandwidth-bound.
+
+VMEM residency bounds the sequence length (~8-16k per chip at Dh=64);
+beyond that the context-parallel ring (ops/ring_attention.py) splits T
+across chips, with this kernel as the per-shard block computation.
+
+Layout: q,k,v [B, T, H, Dh] (model layout) — folded to [B*H, T, Dh] for
+the kernel. Block sizes are multiples of the (8, 128) f32 tile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    # CPU has no Mosaic backend: run kernels in interpret mode so the same
+    # code is testable on the virtual host mesh (SURVEY.md §4 takeaway).
+    return jax.default_backend() == "cpu"
+
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int, causal: bool):
+    # q_ref: [bq, D]; k_ref/v_ref: [T, D]; o_ref: [bq, D]; lse_ref: [bq]
+    iq = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, T]
+    if causal:
+        T = k.shape[0]
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, T), 0) + iq * block_q
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, T), 1)
+        s = jnp.where(col <= row, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    # lse is [8, bq]: a dummy 8-row sublane dim keeps the store tile-legal
+    lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[:, 0][None, :], (8, block_q))
+    p = (p / l).astype(v_ref.dtype)
+    o_ref[...] = jax.lax.dot_general(
+        p, v_ref[...], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, block_q: int, causal: bool):
+    iq = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    T = k.shape[0]
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, T), 0) + iq * block_q
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, T), 1)
+        s = jnp.where(col <= row, s, _NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, None])  # [bq, T]
+    do = do_ref[...].astype(jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v_ref[...].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, T]
+    ds = p * (dp - delta_ref[0][:, None]) * scale
+    dq_ref[...] = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                *, block_k: int, causal: bool):
+    ik = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)     # [T, D] (all queries)
+    k = k_ref[...].astype(jnp.float32)     # [bk, D]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [T, bk]
+    T = q.shape[0]
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (T, block_k), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (T, block_k), 1) + ik * block_k
+        s = jnp.where(col <= row, s, _NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, None])  # [T, bk]
+    do = do_ref[...].astype(jnp.float32)    # [T, D]
+    dv_ref[...] = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dv_ref.dtype)                  # [bk, D]
+    dp = jax.lax.dot_general(
+        do, v_ref[...].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [T, bk]
+    ds = p * (dp - delta_ref[0][:, None]) * scale  # [T, bk]
+    dk_ref[...] = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(dk_ref.dtype)
+
+
+def _pick_block(t: int, target: int = 256) -> int:
+    for b in (target, 128, 64, 32, 16, 8):
+        if t % b == 0:
+            return min(b, t)
+    return t
+
+
+def _fold(x):  # [B, T, H, D] -> [B*H, T, D]
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _unfold(x, B, H):  # [B*H, T, D] -> [B, T, H, D]
+    BH, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, causal: bool = True):
+    out, _ = _flash_fwd(q, k, v, causal)
+    return out
+
+
+def _flash_fwd(q, k, v, causal):
+    B, T, H, D = q.shape
+    qf, kf, vf = _fold(q), _fold(k), _fold(v)
+    BH = B * H
+    bq = _pick_block(T)
+    grid = (BH, T // bq)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=bq, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, 8, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 8, T), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return _unfold(out, B, H), (q, k, v, _unfold_keep(out), lse)
+
+
+def _unfold_keep(x):
+    return x  # folded layout residual; avoids a transpose round-trip
+
+
+def _flash_fwd_rule(q, k, v, causal):
+    out, res = _flash_fwd(q, k, v, causal)
+    return out, res
+
+
+def _flash_bwd_rule(causal, res, dout):
+    q, k, v, out_f, lse = res
+    B, T, H, D = q.shape
+    qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(dout)
+    BH = B * H
+    # delta = rowsum(dO * O), broadcast onto the 8-row sublane layout
+    delta = jnp.sum(dof.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (BH, 8, T))
+
+    bq = _pick_block(T)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_q=bq, causal=causal),
+        grid=(BH, T // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, 8, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((None, 8, bq), lambda b, i: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    bk = _pick_block(T)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_k=bk, causal=causal),
+        grid=(BH, T // bk),
+        in_specs=[
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 8, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, 8, T), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    return _unfold(dq, B, H), _unfold(dk, B, H), _unfold(dv, B, H)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -1,0 +1,123 @@
+"""Ring attention — context parallelism over the mesh "cp" axis.
+
+Sequence-parallel exact attention for sequences too long for one chip:
+each device holds a T/n slice of Q, K, V; K/V blocks rotate around the
+ring via lax.ppermute (nearest-neighbor ICI hops) while every device
+accumulates its queries' attention over all blocks with streaming-softmax
+(running max/sum) merging — numerically identical to full attention.
+
+The reference has NO equivalent (SURVEY.md §5 "long-context": it
+delegates sequence scaling to vLLM/DeepSpeed); this is a required
+capability-parity addition, built TPU-first: the rotation is compiled to
+collective-permute on ICI and overlaps with the block computation.
+
+Round-1 block computation is the einsum form (differentiable end-to-end
+through the ring; per-shard score blocks are [B, H, T/n, T/n]); swapping
+in the Pallas flash kernel per block is a planned optimization.
+
+Usage: inside shard_map with q, k, v sharded on T over axis_name, or via
+ring_attention_sharded() which applies the shard_map given a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _block_scores(q, kb, q_off, k_off, causal):
+    """Masked scores for one (q-shard, k-block) pair, global positions."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bthd,bshd->bhts", q, kb, preferred_element_type=jnp.float32
+    ) * (1.0 / d**0.5)
+    if causal:
+        Tq, Tk = q.shape[1], kb.shape[1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0) + q_off
+        col = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1) + k_off
+        s = jnp.where((col <= row)[None, None], s, _NEG)
+    return s  # [B, H, Tq, Tk] fp32
+
+
+def ring_attention(
+    q: jax.Array,  # local shard [B, Tl, H, Dh]
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "cp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention across the ring; call under shard_map with the
+    sequence dim sharded over `axis_name`."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+
+    def step(carry, s):
+        acc, m_run, l_run, kk, vv = carry
+        # kk/vv currently hold the block originally owned by rank (my - s)
+        src = (my - s) % n
+        scores = _block_scores(q, kk, my * Tl, src * Tl, causal)
+        m_b = jnp.max(scores, axis=-1, keepdims=True)  # [B,H,Tq,1]
+        m_b = jnp.maximum(m_b, _NEG)  # keep fully-masked rows finite
+        p = jnp.exp(scores - m_b)
+        # re-zero fully-masked entries (exp(-1e30 - -1e30) = 1)
+        if causal:
+            p = jnp.where(scores <= _NEG / 2, 0.0, p)
+        l_b = jnp.sum(p, axis=-1, keepdims=True)
+        o_b = jnp.einsum("bhts,bshd->bthd", p.astype(vv.dtype), vv)
+
+        m_new = jnp.maximum(m_run, m_b)
+        scale_run = jnp.exp(m_run - m_new)
+        scale_b = jnp.exp(m_b - m_new)
+        # [B,H,T,1] -> [B,T,H,1] for the output layout
+        tr = lambda x: x.transpose(0, 2, 1, 3)
+        acc = acc * tr(scale_run) + o_b.astype(jnp.float32) * tr(scale_b)
+        l_run = l_run * scale_run + l_b * scale_b
+        m_run = m_new
+        # rotate kv to the next rank (nearest-neighbor ring on ICI)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (acc, m_run, l_run, kk, vv), None
+
+    acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tl, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    (acc, m_run, l_run, _, _), _ = lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n)
+    )
+    l_safe = jnp.maximum(l_run, 1e-30).transpose(0, 2, 1, 3)
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,  # global [B, T, H, Dh]
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    causal: bool = True,
+    cp_axis: str = "cp",
+    batch_axes=("dcn", "dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+) -> jax.Array:
+    """shard_map wrapper: T over cp, batch over data axes, heads over tp."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map  # jax >= 0.8 export
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+    batch = tuple(a for a in batch_axes if a in mesh.shape)
+    spec = P(batch if batch else None, cp_axis, head_axis, None)
+    fn = functools.partial(ring_attention, axis_name=cp_axis, causal=causal)
+    return _shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,26 @@
+"""Parallelism strategies — native mesh/sharding layer.
+
+The reference orchestrates parallelism but delegates the math/comm to
+engines it launches (SURVEY.md §2.4). Here DP/FSDP/TP/CP/EP are provided
+natively: a device mesh with standard axis names, NamedSharding partition
+rules for model pytrees, and XLA collectives over ICI/DCN inserted by the
+compiler from those shardings.
+"""
+
+from ray_tpu.parallel.mesh import MeshConfig, build_mesh, local_mesh
+from ray_tpu.parallel.sharding import (
+    PartitionRules,
+    named_sharding,
+    shard_pytree,
+    with_sharding_constraint,
+)
+
+__all__ = [
+    "MeshConfig",
+    "PartitionRules",
+    "build_mesh",
+    "local_mesh",
+    "named_sharding",
+    "shard_pytree",
+    "with_sharding_constraint",
+]

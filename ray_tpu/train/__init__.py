@@ -1,0 +1,32 @@
+"""ray_tpu.train — distributed training orchestration (Train library).
+
+Parity: ray.train v2 (reference python/ray/train/v2/) with JAX/TPU as the
+first-class backend: JaxTrainer spawns one worker actor per host, wires
+jax.distributed + mesh env, and the train loop uses ray_tpu.parallel for
+dp/fsdp/tp/cp sharding.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import get_context, report
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "get_context",
+    "report",
+]

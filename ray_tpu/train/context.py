@@ -1,0 +1,95 @@
+"""Per-worker train context + report() (parity: ray.train.get_context /
+ray.train.report, reference python/ray/train/context.py)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+class TrainContext:
+    def __init__(
+        self,
+        world_rank: int,
+        world_size: int,
+        local_rank: int,
+        node_rank: int,
+        run_dir: Optional[str],
+        restore_checkpoint: Optional[Checkpoint],
+        collective_group: Optional[str],
+    ):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.run_dir = run_dir
+        self.restore_checkpoint = restore_checkpoint
+        self.collective_group = collective_group
+        self.reports: List[Dict[str, Any]] = []
+        self.report_step = 0
+
+    # -- API parity --
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.restore_checkpoint
+
+    def get_experiment_name(self) -> Optional[str]:
+        return os.path.basename(self.run_dir) if self.run_dir else None
+
+
+def set_context(ctx: Optional[TrainContext]) -> None:
+    _local.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_context() called outside a train worker"
+        )
+    return ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:
+    """Record metrics (and optionally persist a checkpoint) for this step.
+
+    Mirrors the reference flow (SURVEY.md §3.4): every worker reaches a
+    sync barrier; each worker's checkpoint shard is copied into the shared
+    step directory under rank_<r>/; metrics are recorded per worker and
+    rank 0's stream becomes the Result metrics.
+    """
+    ctx = get_context()
+    ctx.report_step += 1
+    step = ctx.report_step
+    if checkpoint is not None and ctx.run_dir is not None:
+        step_dir = os.path.join(ctx.run_dir, f"checkpoint_{step:06d}")
+        rank_dir = os.path.join(step_dir, f"rank_{ctx.world_rank}")
+        os.makedirs(step_dir, exist_ok=True)
+        shutil.copytree(checkpoint.as_directory(), rank_dir, dirs_exist_ok=True)
+    entry = dict(metrics)
+    entry["_step"] = step
+    entry["_has_checkpoint"] = checkpoint is not None
+    ctx.reports.append(entry)
+    # commit barrier so no worker races ahead of a partially-written step
+    if ctx.collective_group is not None and ctx.world_size > 1:
+        from ray_tpu import collective
+
+        collective.barrier(ctx.collective_group)

@@ -1,0 +1,89 @@
+"""DataParallelTrainer / JaxTrainer — the user-facing Train API.
+
+Parity: DataParallelTrainer.fit (reference python/ray/train/v2/api/
+data_parallel_trainer.py:157) and JaxTrainer (train/v2/jax/jax_trainer.py:20).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import Result, RunConfig, ScalingConfig
+from ray_tpu.train.controller import TrainController
+from ray_tpu.utils import serialization
+from ray_tpu.utils.config import config as rt_config
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self._train_fn = train_loop_per_worker
+        self._train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    def _run_dir(self) -> str:
+        base = self.run_config.storage_path or os.path.join(
+            rt_config.temp_dir, "runs"
+        )
+        name = self.run_config.name or f"run_{int(time.time())}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def fit(self) -> Result:
+        run_dir = self._run_dir()
+        cc = self.run_config.checkpoint_config
+        controller = TrainController.options(num_cpus=0).remote(
+            self.scaling_config,
+            run_dir,
+            self.run_config.failure_config.max_failures,
+            cc.num_to_keep,
+            cc.checkpoint_score_attribute,
+            cc.checkpoint_score_order,
+        )
+        try:
+            out = ray_tpu.get(
+                controller.run.remote(
+                    serialization.dumps_function(self._train_fn),
+                    self._train_loop_config,
+                    self.scaling_config.use_tpu,
+                    self.scaling_config.tpu_chips_per_worker,
+                ),
+            )
+        finally:
+            try:
+                ray_tpu.kill(controller)
+            except Exception:  # noqa: BLE001
+                pass
+        error = RuntimeError(out["error"]) if out.get("error") else None
+        metrics = out.get("metrics")
+        if metrics:
+            metrics = {k: v for k, v in metrics.items() if not k.startswith("_")}
+        ckpt = (
+            Checkpoint(out["checkpoint_path"]) if out.get("checkpoint_path") else None
+        )
+        return Result(metrics=metrics, checkpoint=ckpt, error=error, path=run_dir)
+
+
+class JaxTrainer(DataParallelTrainer):
+    """SPMD JAX training: one worker per host, a mesh over all chips.
+
+    Parity: reference JaxTrainer (TPU-only, _validate_scaling_config
+    train/v2/jax/jax_trainer.py:162)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        sc = self.scaling_config
+        if sc.use_tpu and not sc.tpu_chips_per_worker:
+            sc.tpu_chips_per_worker = 1

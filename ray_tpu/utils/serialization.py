@@ -51,8 +51,55 @@ def loads(data: bytes) -> Any:
 
 
 def dumps_function(fn: Any) -> bytes:
-    """Serialize a function/class definition (always cloudpickle)."""
+    """Serialize a function/class definition (always cloudpickle).
+
+    User modules (anything outside site-packages/stdlib/ray_tpu) are
+    registered for by-value pickling so driver-local code runs on workers
+    that cannot import it — the role the reference's runtime_env
+    working_dir upload plays for module-level functions."""
+    _maybe_register_by_value(getattr(fn, "__module__", None))
     return cloudpickle.dumps(fn, protocol=PROTOCOL)
+
+
+_registered_by_value = set()
+
+
+def _maybe_register_by_value(module_name, _depth: int = 0) -> None:
+    """Register a user module — and the user modules it references — for
+    by-value pickling (bounded transitive walk, so `from my_utils import
+    helper` inside the user's module also ships by value)."""
+    import sys
+    import types
+
+    if not module_name or module_name in _registered_by_value or _depth > 3:
+        return
+    top = module_name.split(".")[0]
+    if top in ("ray_tpu", "builtins", "__main__") or top in sys.stdlib_module_names:
+        return
+    module = sys.modules.get(module_name)
+    mod_file = getattr(module, "__file__", None)
+    if module is None or mod_file is None:
+        return
+    if (
+        "site-packages" in mod_file
+        or "dist-packages" in mod_file
+        or mod_file.startswith(sys.prefix)
+        or mod_file.startswith(sys.base_prefix)
+    ):
+        return
+    try:
+        cloudpickle.register_pickle_by_value(module)
+        _registered_by_value.add(module_name)
+    except Exception:  # noqa: BLE001 — fall back to by-reference
+        return
+    # one hop: modules referenced by this module's globals
+    for value in list(vars(module).values()):
+        if isinstance(value, types.ModuleType):
+            _maybe_register_by_value(value.__name__, _depth + 1)
+        else:
+            ref_mod = getattr(value, "__module__", None)
+            if ref_mod and ref_mod != module_name:
+                _maybe_register_by_value(ref_mod, _depth + 1)
 
 
 def pack(obj: Any) -> bytes:

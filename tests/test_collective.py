@@ -1,0 +1,85 @@
+"""Collective library tests: actor groups over the cpu (KV) backend —
+parity model: python/ray/util/collective/tests/single_node_cpu_tests."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, rank, world):
+        self.rank, self.world = rank, world
+
+    def setup(self, group):
+        from ray_tpu import collective
+
+        collective.init_collective_group(self.world, self.rank, "cpu", group)
+        return True
+
+    def do_allreduce(self, group):
+        from ray_tpu import collective
+
+        return collective.allreduce(np.full((4,), self.rank + 1.0), group_name=group)
+
+    def do_allgather(self, group):
+        from ray_tpu import collective
+
+        return collective.allgather(np.array([self.rank]), group_name=group)
+
+    def do_broadcast(self, group):
+        from ray_tpu import collective
+
+        return collective.broadcast(
+            np.arange(3) if self.rank == 0 else np.zeros(3), 0, group
+        )
+
+    def do_reducescatter(self, group):
+        from ray_tpu import collective
+
+        return collective.reducescatter(np.ones((4, 2)), group_name=group)
+
+    def do_sendrecv(self, group):
+        from ray_tpu import collective
+
+        if self.rank == 0:
+            collective.send(np.array([42.0]), 1, group)
+            return None
+        return collective.recv(0, group)
+
+
+def _make_group(rt, n, group):
+    members = [Member.remote(i, n) for i in range(n)]
+    rt.get([m.setup.remote(group) for m in members], timeout=60)
+    return members
+
+
+def test_allreduce_and_allgather(rt):
+    members = _make_group(rt, 2, "g1")
+    out = rt.get([m.do_allreduce.remote("g1") for m in members], timeout=60)
+    np.testing.assert_array_equal(out[0], np.full((4,), 3.0))
+    np.testing.assert_array_equal(out[0], out[1])
+
+    gathered = rt.get([m.do_allgather.remote("g1") for m in members], timeout=60)
+    assert [int(g[0]) for g in gathered[0]] == [0, 1]
+
+
+def test_broadcast_reducescatter_sendrecv(rt):
+    members = _make_group(rt, 2, "g2")
+    out = rt.get([m.do_broadcast.remote("g2") for m in members], timeout=60)
+    np.testing.assert_array_equal(out[1], np.arange(3))
+
+    rs = rt.get([m.do_reducescatter.remote("g2") for m in members], timeout=60)
+    assert rs[0].shape == (2, 2)
+    np.testing.assert_array_equal(rs[0], np.full((2, 2), 2.0))
+
+    sr = rt.get([m.do_sendrecv.remote("g2") for m in members], timeout=60)
+    np.testing.assert_array_equal(sr[1], np.array([42.0]))
