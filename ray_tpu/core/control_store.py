@@ -625,14 +625,31 @@ class ControlStore:
                 time.sleep(min(backoff, 1.0))
                 backoff = min(backoff * 2, 1.0)
                 continue
-            # Phase 2: COMMIT.
+            # Phase 2: COMMIT. A node that misses COMMIT would refuse
+            # bundle leases forever (raylet requires state=="committed"),
+            # so any commit failure rolls the whole PG back and re-places.
+            commit_ok = True
             for node_id, idxs in by_node.items():
                 try:
-                    self._agents.get(view[node_id]["address"]).call(
+                    res = self._agents.get(view[node_id]["address"]).call(
                         "commit_bundles", pg_id=pg_id
                     )
                 except RpcError:
+                    res = False
+                if not res:
                     logger.warning("pg %s commit failed on %s", pg_id[:8], node_id[:8])
+                    commit_ok = False
+            if not commit_ok:
+                for node_id, idxs in by_node.items():
+                    try:
+                        self._agents.get(view[node_id]["address"]).call_oneway(
+                            "return_bundles", pg_id=pg_id
+                        )
+                    except RpcError:
+                        pass
+                time.sleep(min(backoff, 1.0))
+                backoff = min(backoff * 2, 1.0)
+                continue
             with self._lock:
                 pg = self._pgs.get(pg_id)
                 if pg is None:

@@ -282,12 +282,35 @@ class NodeAgent:
                 return {"granted": False, "spillback": target["address"]}
             if target is None and not self._feasible_locally(resources):
                 return {"granted": False, "error": "infeasible"}
+        else:
+            # Bundle pinned to a PG: if this node doesn't host the
+            # *requested bundle index* (it may host other bundles of a
+            # SPREAD PG), spill back to the node that does (control store
+            # records bundle_locations at COMMIT) rather than timing out
+            # forever locally.
+            with self._lock:
+                rec = self._bundles.get(bundle[0])
+                req_idx = bundle[1]
+                have_pg = rec is not None and (
+                    req_idx is None or req_idx < 0 or req_idx in rec["bundles"]
+                )
+            if not have_pg:
+                target = self._pick_bundle_node(bundle)
+                if target == "pending":
+                    # PG exists but hasn't committed anywhere yet — let the
+                    # caller retry (same contract as a lease timeout).
+                    return {"granted": False, "error": "lease timeout"}
+                if target is not None and target["node_id"] != self.node_id.hex():
+                    return {"granted": False, "spillback": target["address"]}
+                if target is None:
+                    return {"granted": False, "error": "bundle not found"}
         deadline = time.monotonic() + wait_s
         kind = "tpu" if resources.get("TPU") else "cpu"
         spawned_for_me = False
         with self._lock:
             while True:
-                if self._try_allocate_locked(resources, bundle):
+                ok, resolved_bundle = self._try_allocate_locked(resources, bundle)
+                if ok:
                     worker = self._pop_idle_worker_locked(kind)
                     if worker is not None:
                         lease_id = uuid.uuid4().hex
@@ -295,7 +318,7 @@ class NodeAgent:
                         worker.lease_id = lease_id
                         self._leases[lease_id] = {
                             "resources": resources,
-                            "bundle": bundle,
+                            "bundle": resolved_bundle,
                             "worker_id": worker.worker_id,
                         }
                         return {
@@ -306,7 +329,7 @@ class NodeAgent:
                         }
                     # Resources ok but no idle worker: undo the allocation,
                     # ensure a spawn is in flight for this request, wait.
-                    self._deallocate_locked(resources, bundle)
+                    self._deallocate_locked(resources, resolved_bundle)
                     if not spawned_for_me:
                         spawned_for_me = True
                         self._lock.release()
@@ -340,24 +363,27 @@ class NodeAgent:
     def _release_resources_locked(self, info: Dict[str, Any]) -> None:
         self._deallocate_locked(info["resources"], info["bundle"])
 
-    def _try_allocate_locked(self, resources, bundle) -> bool:
+    def _try_allocate_locked(self, resources, bundle):
+        """Returns (ok, resolved_bundle). resolved_bundle pins the concrete
+        pool index an index=-1 bundle request landed in, so release returns
+        capacity to the exact pool it came from."""
         if bundle is not None:
             pg_id, idx = bundle
             rec = self._bundles.get(pg_id)
             if rec is None or rec["state"] != "committed":
-                return False
+                return False, None
             pool_idx = self._bundle_pool_index(rec, idx, resources)
             if pool_idx is None:
-                return False
+                return False, None
             pool = rec["available"][pool_idx]
             for k, v in resources.items():
                 pool[k] = pool.get(k, 0.0) - v
-            return True
+            return True, (pg_id, pool_idx)
         if not all(self.resources_available.get(k, 0.0) >= v for k, v in resources.items()):
-            return False
+            return False, None
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) - v
-        return True
+        return True, None
 
     def _bundle_pool_index(self, rec, idx, resources) -> Optional[int]:
         if idx is not None and idx >= 0:
@@ -374,25 +400,42 @@ class NodeAgent:
 
     def _deallocate_locked(self, resources, bundle) -> None:
         if bundle is not None:
-            pg_id, idx = bundle
+            # bundle is always the allocation-resolved (pg_id, pool_idx)
+            # pair — _try_allocate_locked pins the concrete pool, so credit
+            # goes back exactly where it came from.
+            pg_id, pool_idx = bundle
             rec = self._bundles.get(pg_id)
             if rec is None:
-                return
-            pool_idx = idx if (idx is not None and idx >= 0) else None
-            if pool_idx is None:
-                # find the pool it was taken from: best effort — first pool
-                # missing capacity. Store the resolved index on the lease
-                # instead in a future round; here bundles with index=-1 are
-                # uncommon (Train pins explicit indices).
-                pool_idx = sorted(rec["available"])[0] if rec["available"] else None
-            if pool_idx is None:
                 return
             pool = rec["available"].setdefault(pool_idx, {})
             for k, v in resources.items():
                 pool[k] = pool.get(k, 0.0) + v
+            self._maybe_finish_bundle_return_locked(pg_id)
             return
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) + v
+
+    def _bundle_has_active_leases_locked(self, pg_id: str) -> bool:
+        return any(
+            info.get("bundle") and info["bundle"][0] == pg_id
+            for info in self._leases.values()
+        )
+
+    def _maybe_finish_bundle_return_locked(self, pg_id: str) -> None:
+        """Complete a deferred return_bundles once the last lease against
+        the bundle releases (commit-rollback racing a granted lease)."""
+        rec = self._bundles.get(pg_id)
+        if (
+            rec is None
+            or rec.get("state") != "returning"
+            or self._bundle_has_active_leases_locked(pg_id)
+        ):
+            return
+        self._bundles.pop(pg_id, None)
+        for b in rec["bundles"].values():
+            for k, v in b.items():
+                self.resources_available[k] = self.resources_available.get(k, 0.0) + v
+        self._cv.notify_all()
 
     def _pop_idle_worker_locked(self, kind: str = "cpu") -> Optional[_Worker]:
         for w in self._workers.values():
@@ -404,6 +447,30 @@ class NodeAgent:
         return all(
             self.resources_total.get(k, 0.0) >= v for k, v in resources.items()
         )
+
+    def _pick_bundle_node(self, bundle):
+        """Resolve which node hosts a PG bundle via the control store."""
+        pg_id, idx = bundle
+        try:
+            pg = self._control.call("get_placement_group", pg_id=pg_id)
+            view = self._control.call("get_cluster_view", timeout_s=5.0)
+        except RpcError:
+            # Transient control-store failure must not become a permanent
+            # "bundle not found" for a healthy PG — have the caller retry.
+            return "pending"
+        if not pg:
+            return None
+        locs = pg.get("bundle_locations") or {}
+        if not locs:
+            return "pending"
+        node_id = None
+        if idx is not None and idx >= 0:
+            node_id = locs.get(idx, locs.get(str(idx)))
+        elif locs:
+            node_id = next(iter(locs.values()))
+        if node_id is None or node_id not in view:
+            return None
+        return {"node_id": node_id, "address": view[node_id]["address"]}
 
     def _pick_target_node(self, resources, strategy):
         """Cluster view consult for spillback (reference hybrid policy)."""
@@ -424,8 +491,17 @@ class NodeAgent:
 
     def rpc_prepare_bundles(self, conn, pg_id: str, bundles: Dict[int, Dict[str, float]]):
         with self._lock:
-            if pg_id in self._bundles:
-                return True  # idempotent retry
+            existing = self._bundles.get(pg_id)
+            if existing is not None:
+                # Idempotent retry only if it's the same reservation still
+                # standing. A record draining out ("returning") or one with
+                # a different bundle set must NOT be resurrected — that
+                # would cancel the deferred return / corrupt accounting.
+                return (
+                    existing["state"] != "returning"
+                    and existing["bundles"]
+                    == {int(i): dict(b) for i, b in bundles.items()}
+                )
             need: Dict[str, float] = {}
             for b in bundles.values():
                 for k, v in b.items():
@@ -452,9 +528,16 @@ class NodeAgent:
 
     def rpc_return_bundles(self, conn, pg_id: str):
         with self._lock:
-            rec = self._bundles.pop(pg_id, None)
+            rec = self._bundles.get(pg_id)
             if rec is None:
                 return True
+            if self._bundle_has_active_leases_locked(pg_id):
+                # A lease was granted against a committed bundle before the
+                # rollback arrived: defer — no NEW allocations (state !=
+                # "committed"), and the last release completes the return.
+                rec["state"] = "returning"
+                return True
+            self._bundles.pop(pg_id, None)
             for b in rec["bundles"].values():
                 for k, v in b.items():
                     self.resources_available[k] = self.resources_available.get(k, 0.0) + v
@@ -485,6 +568,11 @@ class NodeAgent:
 
     def rpc_store_usage(self, conn):
         return self.store.usage()
+
+    def rpc_read_object_chunk(self, conn, path: str, offset: int, length: int):
+        """Serve a byte range of a local segment to a cross-node puller
+        (reference C8: push_manager.h chunked transfer)."""
+        return self.store.read_chunk(path, offset, length)
 
     # ------------------------------------------------------------------
     # introspection (state API backing)

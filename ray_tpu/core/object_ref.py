@@ -47,12 +47,15 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()})"
 
     def __reduce__(self):
-        # Crossing a process boundary: pin the object on the owner side (it
-        # may now have remote holders the owner can't see — round-1
-        # borrowing simplification), and make the receiver reconstruct via
-        # _deserialize so borrows are registered.
-        _get_tracker().mark_escaped(self)
-        return (_deserialize_ref, (self.id, self.owner_address))
+        # Crossing a process boundary: create an in-flight pin at the owner
+        # keyed by a fresh token; the deserializer's add_borrow consumes the
+        # token so the pin transfers to the borrower (and is released when
+        # the borrower's last local ref is GC'd).
+        import uuid
+
+        token = uuid.uuid4().hex
+        _get_tracker().on_serialize(self, token)
+        return (_deserialize_ref, (self.id, self.owner_address, token))
 
     def __del__(self):
         if not self._weak:
@@ -80,9 +83,13 @@ class ObjectRef:
         return fut
 
 
-def _deserialize_ref(object_id: ObjectID, owner_address: str) -> ObjectRef:
-    ref = ObjectRef(object_id, owner_address, weak=True)
-    _get_tracker().add_borrowed_ref(ref)
+def _deserialize_ref(
+    object_id: ObjectID, owner_address: str, token: Optional[str] = None
+) -> ObjectRef:
+    # weak=False: the borrow must be released when the local ref is GC'd,
+    # so the ref participates in local refcounting like any other.
+    ref = ObjectRef(object_id, owner_address, weak=False)
+    _get_tracker().on_deserialize(ref, token)
     return ref
 
 
@@ -93,10 +100,10 @@ class _NullTracker:
     def remove_local_ref(self, ref):
         pass
 
-    def add_borrowed_ref(self, ref):
+    def on_serialize(self, ref, token):
         pass
 
-    def mark_escaped(self, ref):
+    def on_deserialize(self, ref, token):
         pass
 
 

@@ -46,7 +46,9 @@ class ShmObjectStore:
         self._objects: Dict[str, Tuple[str, int, bool]] = {}
 
     def create(self, oid_hex: str, size: int) -> str:
-        path = f"{self._prefix}_{oid_hex[:24]}"
+        # Full hex: ObjectIDs share a long job/task prefix, so any
+        # truncation collides across a job's objects.
+        path = f"{self._prefix}_{oid_hex}"
         with self._lock:
             if oid_hex in self._objects:
                 raise ValueError(f"object {oid_hex} already exists")
@@ -105,6 +107,21 @@ class ShmObjectStore:
             os.unlink(entry[0])
         except OSError:
             pass
+
+    def read_chunk(self, path: str, offset: int, length: int) -> Optional[bytes]:
+        """Read a byte range of a sealed segment (serving cross-node pulls).
+        Only paths created by this store are readable."""
+        if not path.startswith(self._prefix):
+            raise ValueError(f"path {path} is not in this store")
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            os.lseek(fd, offset, os.SEEK_SET)
+            return os.read(fd, length)
+        finally:
+            os.close(fd)
 
     def usage(self) -> Tuple[int, int]:
         with self._lock:

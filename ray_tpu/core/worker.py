@@ -27,6 +27,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import object_store as os_mod
+from collections import OrderedDict
+
 from ray_tpu.core.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -77,14 +79,28 @@ def set_global_worker(w: Optional["CoreWorker"]) -> None:
 
 
 class ReferenceTracker:
-    """Per-process ref bookkeeping (reference: reference_counter.h:44)."""
+    """Per-process ref bookkeeping (reference: reference_counter.h:44).
+
+    Borrow protocol (token-based, replaces round-1 permanent escape
+    pinning): every serialization of a ref creates an *in-flight pin* at
+    the owner, tagged with a fresh token. The deserializer's add_borrow
+    *consumes* the token — transferring the pin to the borrower — so the
+    pin lives exactly as long as the borrow. A ref serialized but never
+    deserialized leaks its one pin (bounded; Ray solves this with
+    task-completion borrow reports — out of scope here).
+    """
 
     def __init__(self, worker: "CoreWorker"):
         self._worker = worker
         self._lock = threading.Lock()
         self._local_counts: Dict[ObjectID, int] = {}
-        self._escaped: set = set()
         self._borrows: Dict[ObjectID, int] = {}  # owner side: remote borrowers
+        self._escape_tokens: Dict[str, ObjectID] = {}  # owner side: in-flight pins
+        # Tokens whose consume arrived before their register (one-way RPCs
+        # on different sockets have no cross-connection ordering): a later
+        # register for one of these must be dropped, not pinned forever.
+        self._consumed_tokens: "OrderedDict[str, None]" = OrderedDict()
+        self._borrow_sends: Dict[ObjectID, int] = {}  # borrower side: add_borrows sent
 
     def add_local_ref(self, ref: ObjectRef) -> None:
         with self._lock:
@@ -92,47 +108,109 @@ class ReferenceTracker:
 
     def remove_local_ref(self, ref: ObjectRef) -> None:
         delete = False
-        release_owner = None
+        release = None
         with self._lock:
             count = self._local_counts.get(ref.id, 0) - 1
             if count <= 0:
                 self._local_counts.pop(ref.id, None)
                 if self._worker.owns(ref):
-                    if ref.id not in self._escaped and not self._borrows.get(ref.id):
+                    if not self._borrows.get(ref.id):
                         delete = True
                 else:
-                    release_owner = ref.owner_address
+                    release = self._borrow_sends.pop(ref.id, 0)
             else:
                 self._local_counts[ref.id] = count
         if delete:
             self._worker.delete_owned_object(ref.id)
-        elif release_owner:
-            self._worker.send_release_borrow(release_owner, ref.id)
+        elif release:
+            self._worker.send_release_borrow(ref.owner_address, ref.id, n=release)
 
-    def add_borrowed_ref(self, ref: ObjectRef) -> None:
-        # Count it locally like any ref; notify the owner once.
-        with self._lock:
-            self._local_counts[ref.id] = self._local_counts.get(ref.id, 0) + 1
-        if not self._worker.owns(ref):
-            self._worker.send_add_borrow(ref.owner_address, ref.id)
+    def on_serialize(self, ref: ObjectRef, token: str) -> None:
+        """A ref is crossing a process boundary: pin the object at the
+        owner for the duration of the flight, keyed by token."""
+        if self._worker.owns(ref):
+            with self._lock:
+                self._escape_tokens[token] = ref.id
+                self._borrows[ref.id] = self._borrows.get(ref.id, 0) + 1
+        else:
+            self._worker.send_add_borrow(
+                ref.owner_address, ref.id, register_token=token
+            )
 
-    def mark_escaped(self, ref: ObjectRef) -> None:
-        if not self._worker.owns(ref):
+    def on_deserialize(self, ref: ObjectRef, token: Optional[str]) -> None:
+        """A ref arrived from another process; take over its in-flight pin
+        (or add a fresh borrow if the token was already consumed)."""
+        if self._worker.owns(ref):
+            # Our own ref came back: the local count now guards it.
+            consume = False
+            with self._lock:
+                if token is not None:
+                    if token in self._escape_tokens:
+                        del self._escape_tokens[token]
+                        consume = True
+                    else:
+                        # The serializer's register (a one-way RPC on another
+                        # socket) hasn't landed yet: remember the token so the
+                        # late register is dropped instead of pinning forever.
+                        self._consumed_tokens[token] = None
+                        while len(self._consumed_tokens) > 65536:
+                            self._consumed_tokens.popitem(last=False)
+            if consume:
+                self.owner_release_borrow(ref.id)
             return
         with self._lock:
-            self._escaped.add(ref.id)
+            self._borrow_sends[ref.id] = self._borrow_sends.get(ref.id, 0) + 1
+        self._worker.send_add_borrow(
+            ref.owner_address, ref.id, consume_token=token
+        )
 
-    def owner_add_borrow(self, oid: ObjectID) -> None:
+    def owner_add_borrow(
+        self,
+        oid: ObjectID,
+        register_token: Optional[str] = None,
+        consume_token: Optional[str] = None,
+    ) -> None:
         with self._lock:
+            if consume_token is not None:
+                if consume_token in self._escape_tokens:
+                    # Transfer the in-flight pin to this borrower: no increment.
+                    del self._escape_tokens[consume_token]
+                    return
+                # Consume beat its register (no cross-socket ordering):
+                # count this borrower now and remember the token so the
+                # late register is dropped instead of pinning forever.
+                self._consumed_tokens[consume_token] = None
+                while len(self._consumed_tokens) > 65536:
+                    self._consumed_tokens.popitem(last=False)
+            if register_token is not None:
+                if register_token in self._consumed_tokens:
+                    # The deserializer already took (and counted) this pin.
+                    return
+                self._escape_tokens[register_token] = oid
             self._borrows[oid] = self._borrows.get(oid, 0) + 1
 
-    def owner_release_borrow(self, oid: ObjectID) -> None:
+    def owner_release_borrow(self, oid: ObjectID, n: int = 1) -> None:
+        delete = False
         with self._lock:
-            n = self._borrows.get(oid, 0) - 1
-            if n <= 0:
+            remaining = self._borrows.get(oid, 0) - n
+            if remaining <= 0:
                 self._borrows.pop(oid, None)
+                if not self._local_counts.get(oid):
+                    delete = True
             else:
-                self._borrows[oid] = n
+                self._borrows[oid] = remaining
+        if delete and self._worker.owns_id(oid):
+            # If the producing task hasn't stored the result yet, the store
+            # hook (maybe_delete_unreferenced at _store_task_reply) catches
+            # the release-before-store ordering.
+            self._worker.delete_owned_object(oid)
+
+    def maybe_delete_unreferenced(self, oid: ObjectID) -> bool:
+        """True if nothing (local refs, borrows, in-flight pins) can ever
+        reach this object — called when a task result lands after all its
+        refs were already dropped."""
+        with self._lock:
+            return not self._local_counts.get(oid) and not self._borrows.get(oid)
 
 
 class _ActorRuntime:
@@ -213,6 +291,11 @@ class CoreWorker:
 
     def owns(self, ref: ObjectRef) -> bool:
         return ref.owner_address == self.address
+
+    def owns_id(self, oid: ObjectID) -> bool:
+        """True if this worker is the owner of an object it stores locally
+        (used when only the id, not a ref with owner address, is at hand)."""
+        return self.memory_store.contains(oid)
 
     def current_task_id(self) -> Optional[TaskID]:
         return getattr(self._current_ctx, "task_id", None) or self.driver_task_id
@@ -371,6 +454,7 @@ class CoreWorker:
         try:
             reply = client.call(
                 "get_object", oid_hex=ref.id.hex(), wait_s=timeout_s,
+                requester_agent=self.node_agent_address,
                 timeout_s=(timeout_s + 30.0) if timeout_s is not None else 86400.0,
             )
         except RpcTimeout:
@@ -387,6 +471,14 @@ class CoreWorker:
         if isinstance(stored, (bytes, bytearray, memoryview)):
             return serialization.unpack(stored)
         if isinstance(stored, PlasmaValue):
+            if stored.agent_address != self.node_agent_address:
+                # Owner-side ref to a segment hosted on another node (the
+                # producing task ran remotely): pull through that node's
+                # agent rather than touching a path that only exists there.
+                data = self._pull_remote_segment(
+                    stored.path, stored.size, stored.agent_address
+                )
+                return serialization.unpack(data)
             view = self.shm.read_view(stored.path, stored.size)
             return serialization.unpack(view)
         if isinstance(stored, TaskError):
@@ -405,9 +497,39 @@ class CoreWorker:
             path, size = payload
             view = self.shm.read_view(path, size)
             return serialization.unpack(view)
+        if kind == "remote_plasma":
+            # Object lives in another host's shm store: pull it in chunks
+            # through that host's node agent (reference C8 object-manager
+            # push/pull, object_manager.h:128 — chunked transfer).
+            path, size, agent_address = payload
+            data = self._pull_remote_segment(path, size, agent_address)
+            return serialization.unpack(data)
         if kind == "error":
             raise payload
         raise RuntimeError(f"unexpected get_object reply kind {kind}")
+
+    def _pull_remote_segment(
+        self, path: str, size: int, agent_address: str
+    ) -> memoryview:
+        chunk = config.object_transfer_chunk_size
+        agent = self.agents.get(agent_address)
+        buf = bytearray(size)
+        off = 0
+        while off < size:
+            n = min(chunk, size - off)
+            piece = agent.call(
+                "read_object_chunk", path=path, offset=off, length=n,
+                timeout_s=60.0,
+            )
+            if not piece:
+                # None (file gone) or b'' (segment shorter than recorded —
+                # truncated/replaced): either way the object is lost.
+                raise ObjectLostError(
+                    f"remote segment {path} vanished during transfer"
+                )
+            buf[off:off + len(piece)] = piece
+            off += len(piece)
+        return memoryview(buf)  # no copy; unpack accepts buffer views
 
     def wait(
         self,
@@ -450,9 +572,14 @@ class CoreWorker:
                 for r, ok in zip(group, states):
                     if ok:
                         ready.add(r)
-            except RpcError:
-                # owner gone: surfacing the error counts as ready
+            except RpcConnectionError:
+                # owner actually unreachable: surfacing the error counts as
+                # ready (get() will raise OwnerDiedError)
                 ready.update(group)
+            except RpcError:
+                # transient (e.g. RpcTimeout under load): leave pending and
+                # probe again next tick
+                pass
         return ready
 
     def free(self, refs: List[ObjectRef]) -> None:
@@ -478,16 +605,27 @@ class CoreWorker:
             except RpcError:
                 pass
 
-    def send_add_borrow(self, owner_address: str, oid: ObjectID) -> None:
+    def send_add_borrow(
+        self,
+        owner_address: str,
+        oid: ObjectID,
+        register_token: Optional[str] = None,
+        consume_token: Optional[str] = None,
+    ) -> None:
         try:
-            self.workers.get(owner_address).call_oneway("add_borrow", oid_hex=oid.hex())
+            self.workers.get(owner_address).call_oneway(
+                "add_borrow", oid_hex=oid.hex(),
+                register_token=register_token, consume_token=consume_token,
+            )
         except RpcError:
             pass
 
-    def send_release_borrow(self, owner_address: str, oid: ObjectID) -> None:
+    def send_release_borrow(
+        self, owner_address: str, oid: ObjectID, n: int = 1
+    ) -> None:
         try:
             self.workers.get(owner_address).call_oneway(
-                "release_borrow", oid_hex=oid.hex()
+                "release_borrow", oid_hex=oid.hex(), n=n
             )
         except RpcError:
             pass
@@ -603,7 +741,11 @@ class CoreWorker:
                 agent = self.agents.get(spill)
                 continue
             if lease.get("error") == "lease timeout":
-                continue  # stay queued (reference behavior: leases wait)
+                # Stay queued (reference behavior: leases wait). The agent
+                # answers instantly for pending PGs, so back off briefly to
+                # avoid hammering it and the control store in a tight loop.
+                time.sleep(0.2)
+                continue
             raise TaskError(
                 f"task {spec.name} unschedulable: {lease.get('error')} "
                 f"(resources={spec.resources})"
@@ -650,6 +792,9 @@ class CoreWorker:
                 elif kind == "plasma":
                     path, size, agent_addr = payload
                     self.memory_store.put(oid, PlasmaValue(path, size, agent_addr))
+                if self.reference_tracker.maybe_delete_unreferenced(oid):
+                    # every ref (and borrow) died while the task was running
+                    self.delete_owned_object(oid)
         elif reply["status"] == "cancelled":
             err = TaskCancelledError(f"task {spec.name} was cancelled")
             for i in range(spec.num_returns):
@@ -927,7 +1072,13 @@ class CoreWorker:
 
     # -- object service (owner side) --
 
-    def rpc_get_object(self, conn, oid_hex: str, wait_s: Optional[float] = None):
+    def rpc_get_object(
+        self,
+        conn,
+        oid_hex: str,
+        wait_s: Optional[float] = None,
+        requester_agent: Optional[str] = None,
+    ):
         oid = ObjectID.from_hex(oid_hex)
         try:
             stored = self.memory_store.get(oid, wait_s)
@@ -936,6 +1087,17 @@ class CoreWorker:
         if isinstance(stored, (bytes, bytearray)):
             return ("frame", stored)
         if isinstance(stored, PlasmaValue):
+            if (
+                requester_agent is not None
+                and requester_agent != stored.agent_address
+            ):
+                # Requester is on a different host: the shm path is useless
+                # to it. Hand back the hosting agent's address so the
+                # requester pulls the segment in chunks from that agent.
+                return (
+                    "remote_plasma",
+                    (stored.path, stored.size, stored.agent_address),
+                )
             return ("plasma", (stored.path, stored.size))
         if isinstance(stored, LostValue):
             return ("error", ObjectLostError(stored.message))
@@ -955,12 +1117,18 @@ class CoreWorker:
         self.delete_owned_object(ObjectID.from_hex(oid_hex))
         return True
 
-    def rpc_add_borrow(self, conn, oid_hex: str):
-        self.reference_tracker.owner_add_borrow(ObjectID.from_hex(oid_hex))
+    def rpc_add_borrow(
+        self, conn, oid_hex: str, register_token=None, consume_token=None
+    ):
+        self.reference_tracker.owner_add_borrow(
+            ObjectID.from_hex(oid_hex),
+            register_token=register_token,
+            consume_token=consume_token,
+        )
         return True
 
-    def rpc_release_borrow(self, conn, oid_hex: str):
-        self.reference_tracker.owner_release_borrow(ObjectID.from_hex(oid_hex))
+    def rpc_release_borrow(self, conn, oid_hex: str, n: int = 1):
+        self.reference_tracker.owner_release_borrow(ObjectID.from_hex(oid_hex), n=n)
         return True
 
     def rpc_cancel_task(self, conn, task_id_hex: str):

@@ -100,6 +100,9 @@ config.define("health_check_period_s", 1.0)
 config.define("health_check_timeout_s", 10.0)
 config.define("max_direct_call_object_size", 100 * 1024)
 config.define("object_store_memory_mb", 1024)
+# Cross-node object transfer chunk size (reference C8 push/pull: 1MB
+# chunks, object_manager.proto); larger here since transport is TCP.
+config.define("object_transfer_chunk_size", 4 * 1024 * 1024)
 config.define("worker_register_timeout_s", 30.0)
 config.define("worker_pool_prestart", 0)
 config.define("worker_idle_timeout_s", 600.0)

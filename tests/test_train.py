@@ -73,15 +73,18 @@ def _mlp_train_fn(config):
         params = jax.tree.map(lambda p, g: p - lr * jnp.asarray(g), params, grads)
 
         if config.get("crash_at") is not None and step == config["crash_at"]:
-            # crash only on the first attempt, using KV as the flag
-            from ray_tpu.core import worker as wm
+            # crash only on the first attempt, using KV as the flag; only
+            # rank 0 attempts the claim so another rank can't consume it
+            # and leave nobody crashing
+            if ctx.get_world_rank() == 0:
+                from ray_tpu.core import worker as wm
 
-            first = wm.global_worker().control.call(
-                "kv_put", ns="test", key="train_crash", value=b"1",
-                overwrite=False,
-            )
-            if first and ctx.get_world_rank() == 0:
-                os._exit(1)
+                first = wm.global_worker().control.call(
+                    "kv_put", ns="test", key="train_crash", value=b"1",
+                    overwrite=False,
+                )
+                if first:
+                    os._exit(1)
 
         import tempfile
 
