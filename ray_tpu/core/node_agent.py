@@ -410,32 +410,9 @@ class NodeAgent:
             pool = rec["available"].setdefault(pool_idx, {})
             for k, v in resources.items():
                 pool[k] = pool.get(k, 0.0) + v
-            self._maybe_finish_bundle_return_locked(pg_id)
             return
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) + v
-
-    def _bundle_has_active_leases_locked(self, pg_id: str) -> bool:
-        return any(
-            info.get("bundle") and info["bundle"][0] == pg_id
-            for info in self._leases.values()
-        )
-
-    def _maybe_finish_bundle_return_locked(self, pg_id: str) -> None:
-        """Complete a deferred return_bundles once the last lease against
-        the bundle releases (commit-rollback racing a granted lease)."""
-        rec = self._bundles.get(pg_id)
-        if (
-            rec is None
-            or rec.get("state") != "returning"
-            or self._bundle_has_active_leases_locked(pg_id)
-        ):
-            return
-        self._bundles.pop(pg_id, None)
-        for b in rec["bundles"].values():
-            for k, v in b.items():
-                self.resources_available[k] = self.resources_available.get(k, 0.0) + v
-        self._cv.notify_all()
 
     def _pop_idle_worker_locked(self, kind: str = "cpu") -> Optional[_Worker]:
         for w in self._workers.values():
@@ -493,15 +470,11 @@ class NodeAgent:
         with self._lock:
             existing = self._bundles.get(pg_id)
             if existing is not None:
-                # Idempotent retry only if it's the same reservation still
-                # standing. A record draining out ("returning") or one with
-                # a different bundle set must NOT be resurrected — that
-                # would cancel the deferred return / corrupt accounting.
-                return (
-                    existing["state"] != "returning"
-                    and existing["bundles"]
-                    == {int(i): dict(b) for i, b in bundles.items()}
-                )
+                # Idempotent retry only if it's the same reservation; a
+                # record with a different bundle set must NOT be resurrected.
+                return existing["bundles"] == {
+                    int(i): dict(b) for i, b in bundles.items()
+                }
             need: Dict[str, float] = {}
             for b in bundles.values():
                 for k, v in b.items():
@@ -527,22 +500,28 @@ class NodeAgent:
             return True
 
     def rpc_return_bundles(self, conn, pg_id: str):
+        # Any lease granted against this PG is void — the group never fully
+        # committed (or is being removed) — so the worker holding it is
+        # killed and its caller retries against the re-placed PG. (The
+        # reference likewise kills workers using a removed PG's bundles.)
+        doomed = []
         with self._lock:
-            rec = self._bundles.get(pg_id)
+            rec = self._bundles.pop(pg_id, None)
             if rec is None:
                 return True
-            if self._bundle_has_active_leases_locked(pg_id):
-                # A lease was granted against a committed bundle before the
-                # rollback arrived: defer — no NEW allocations (state !=
-                # "committed"), and the last release completes the return.
-                rec["state"] = "returning"
-                return True
-            self._bundles.pop(pg_id, None)
+            for lease_id, info in list(self._leases.items()):
+                if info.get("bundle") and info["bundle"][0] == pg_id:
+                    self._leases.pop(lease_id, None)
+                    w = self._workers.pop(info["worker_id"], None)
+                    if w is not None:
+                        doomed.append(w)
             for b in rec["bundles"].values():
                 for k, v in b.items():
                     self.resources_available[k] = self.resources_available.get(k, 0.0) + v
             self._cv.notify_all()
-            return True
+        for w in doomed:
+            self._terminate_worker(w)
+        return True
 
     # ------------------------------------------------------------------
     # object store host (reference C7)

@@ -22,7 +22,10 @@ class TaskOptions:
     num_cpus: Optional[float] = None
     num_tpus: Optional[float] = None
     resources: Dict[str, float] = field(default_factory=dict)
-    max_retries: int = 0
+    # None → config.task_max_retries at submit time (system failures only,
+    # like the reference's default of 3; app exceptions need
+    # retry_exceptions=True).
+    max_retries: Optional[int] = None
     retry_exceptions: bool = False
     scheduling_strategy: Any = None  # see core.scheduling docstring
     name: Optional[str] = None

@@ -102,6 +102,11 @@ class ReferenceTracker:
         self._consumed_tokens: "OrderedDict[str, None]" = OrderedDict()
         self._borrow_sends: Dict[ObjectID, int] = {}  # borrower side: add_borrows sent
 
+    def _remember_consumed_locked(self, token: str) -> None:
+        self._consumed_tokens[token] = None
+        while len(self._consumed_tokens) > 65536:
+            self._consumed_tokens.popitem(last=False)
+
     def add_local_ref(self, ref: ObjectRef) -> None:
         with self._lock:
             self._local_counts[ref.id] = self._local_counts.get(ref.id, 0) + 1
@@ -152,9 +157,7 @@ class ReferenceTracker:
                         # The serializer's register (a one-way RPC on another
                         # socket) hasn't landed yet: remember the token so the
                         # late register is dropped instead of pinning forever.
-                        self._consumed_tokens[token] = None
-                        while len(self._consumed_tokens) > 65536:
-                            self._consumed_tokens.popitem(last=False)
+                        self._remember_consumed_locked(token)
             if consume:
                 self.owner_release_borrow(ref.id)
             return
@@ -179,9 +182,7 @@ class ReferenceTracker:
                 # Consume beat its register (no cross-socket ordering):
                 # count this borrower now and remember the token so the
                 # late register is dropped instead of pinning forever.
-                self._consumed_tokens[consume_token] = None
-                while len(self._consumed_tokens) > 65536:
-                    self._consumed_tokens.popitem(last=False)
+                self._remember_consumed_locked(consume_token)
             if register_token is not None:
                 if register_token in self._consumed_tokens:
                     # The deserializer already took (and counted) this pin.
@@ -648,7 +649,11 @@ class CoreWorker:
             num_returns=options.num_returns,
             owner_address=self.address,
             resources=options.resource_demand(default_cpus=1.0),
-            max_retries=options.max_retries,
+            max_retries=(
+                options.max_retries
+                if options.max_retries is not None
+                else config.task_max_retries
+            ),
             retry_exceptions=options.retry_exceptions,
             name=options.name or fn_name,
         )

@@ -53,6 +53,25 @@ def test_cancel_queued_task(rt):
     rt.get(hogs)  # drain
 
 
+def test_tasks_survive_rpc_chaos(rt):
+    """Probabilistic RPC failure injection on the lease path (mirror of the
+    reference's RAY_testing_rpc_failure, src/ray/rpc/rpc_chaos.cc): tasks
+    must still complete via submit retries."""
+    from ray_tpu.utils.config import config
+
+    @rt.remote
+    def inc(x):
+        return x + 1
+
+    config.set("testing_rpc_failure", "lease_worker:0.1:0.0")
+    try:
+        assert rt.get([inc.remote(i) for i in range(12)], timeout=120) == list(
+            range(1, 13)
+        )
+    finally:
+        config.set("testing_rpc_failure", "")
+
+
 def test_escaped_ref_survives_local_del(rt):
     """A ref serialized into task args must pin the object even if the
     caller drops its local reference before the task runs."""
