@@ -1,0 +1,38 @@
+"""Flash kernel perf on TPU: fwd and fwd+bwd, floor-corrected."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.flash_attention import flash_attention
+
+PEAK = 197e12
+B, T, H, Dh = 32, 1024, 12, 64
+attn_flops = 4 * B * H * T * T * Dh  # fwd core (2 matmuls), causal halves work
+
+f = jax.jit(lambda: jnp.sum(jnp.ones((8, 128), jnp.float32)))
+float(f())
+t0 = time.perf_counter(); float(f()); FLOOR = time.perf_counter() - t0
+print(f"floor {FLOOR*1e3:.0f} ms")
+
+q = jax.random.normal(jax.random.PRNGKey(0), (B, T, H, Dh), jnp.bfloat16)
+
+
+def loop(name, body, init, K, flops):
+    fn = jax.jit(lambda x0: jax.lax.fori_loop(0, K, lambda i, x: body(x), x0))
+    out = fn(init)
+    float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    out = fn(init)
+    float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+    dt = time.perf_counter() - t0 - FLOOR
+    print(f"{name}: {dt/K*1e3:.2f} ms/iter  {flops*K/dt/PEAK:.3f} of peak")
+
+
+loop("flash fwd causal", lambda q: flash_attention(q, q, q, True), q, 24, attn_flops)
+loop("flash fwd non-causal", lambda q: flash_attention(q, q, q, False), q, 24, attn_flops)
+
+
+def g(q):
+    return jax.grad(lambda q: flash_attention(q, q, q, True).astype(jnp.float32).sum())(q)
+loop("flash fwd+bwd causal", g, q, 12, int(attn_flops * 3.5))

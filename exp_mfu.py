@@ -1,0 +1,45 @@
+"""MFU experiment: remat x loss-chunking variants on the real chip."""
+import dataclasses
+import time
+
+import jax
+import optax
+
+from ray_tpu.models import gpt2
+
+PEAK = 197e12
+
+
+def run(name, cfg, batch=32, seq=1024, steps=5):
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, dtype="int32"
+    )
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    try:
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        dt = time.perf_counter() - t0
+    except Exception as e:
+        print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}")
+        return
+    tps = batch * seq * steps / dt
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    mfu = tps * 6.0 * n_params / PEAK
+    print(f"{name}: {tps:,.0f} tok/s  mfu={mfu:.4f}  compile={compile_s:.1f}s  loss={float(loss):.3f}")
+
+
+base = dataclasses.replace(gpt2.CONFIGS["gpt2-small"], attn_impl="flash")
+run("A remat-full chunk0   ", dataclasses.replace(base, remat=True, loss_chunk=0))
+run("B remat-full chunk128 ", dataclasses.replace(base, remat=True, loss_chunk=128))
+run("C no-remat   chunk128 ", dataclasses.replace(base, remat=False, loss_chunk=128))
+run("D remat-dots chunk128 ", dataclasses.replace(base, remat=True, remat_policy="dots", loss_chunk=128))
+run("E no-remat   chunk256 ", dataclasses.replace(base, remat=False, loss_chunk=256))

@@ -1,0 +1,79 @@
+"""Component probes: matmul ceiling, fwd/bwd split, attention impl delta."""
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import gpt2
+
+PEAK = 197e12
+
+
+def _sync(out):
+    leaf = jax.tree.leaves(out)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def timeit(fn, *args, n=5):
+    out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / n
+
+
+# 1. pure matmul ceiling (bf16)
+for m in (4096, 8192):
+    a = jnp.ones((m, m), jnp.bfloat16)
+    b = jnp.ones((m, m), jnp.bfloat16)
+    f = jax.jit(lambda a, b: a @ b)
+    dt = timeit(f, a, b)
+    print(f"matmul {m}: {2*m**3/dt/PEAK:.3f} of peak ({dt*1e3:.2f} ms)")
+
+cfg = dataclasses.replace(gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=True, loss_chunk=0)
+params = gpt2.init(jax.random.PRNGKey(0), cfg)
+B, T = 32, 1024
+tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab_size, dtype="int32")
+n_params = sum(x.size for x in jax.tree.leaves(params))
+
+# 2. forward-only loss
+f_fwd = jax.jit(lambda p, t: gpt2.loss_fn(p, t, cfg))
+dt = timeit(f_fwd, params, tokens)
+print(f"fwd loss: {dt*1e3:.1f} ms  ({2*n_params*B*T/dt/PEAK:.3f} of peak @2PD)")
+
+# 3. grad (no optimizer)
+f_grad = jax.jit(lambda p, t: jax.grad(lambda p: gpt2.loss_fn(p, t, cfg))(p))
+dt = timeit(f_grad, params, tokens)
+print(f"fwd+bwd: {dt*1e3:.1f} ms  ({6*n_params*B*T/dt/PEAK:.3f} of peak @6PD)")
+
+# 4. backbone only fwd (no head/loss)
+f_bb = jax.jit(lambda p, t: gpt2.backbone(p, t, cfg))
+dt = timeit(f_bb, params, tokens[:, :-1])
+bb_flops = 2 * (n_params - cfg.padded_vocab * cfg.d_model) * B * T + 4*B*cfg.n_head*T*T*cfg.head_dim
+print(f"backbone fwd: {dt*1e3:.1f} ms  ({bb_flops/dt/PEAK:.3f} of peak)")
+
+# 5. head only: [B*T, D] @ [D, V]
+x = jnp.ones((B * T, cfg.d_model), jnp.bfloat16)
+w = jnp.ones((cfg.padded_vocab, cfg.d_model), jnp.bfloat16)
+f_head = jax.jit(lambda x, w: jnp.einsum("td,vd->tv", x, w, preferred_element_type=jnp.float32))
+dt = timeit(f_head, x, w)
+print(f"head matmul fp32out: {dt*1e3:.1f} ms  ({2*B*T*cfg.d_model*cfg.padded_vocab/dt/PEAK:.3f} of peak)")
+
+# 6. attention impl comparison (fwd+bwd of one loss)
+for impl in ("reference", "flash"):
+    c2 = dataclasses.replace(cfg, attn_impl=impl)
+    f2 = jax.jit(lambda p, t: jax.grad(lambda p: gpt2.loss_fn(p, t, c2))(p))
+    dt = timeit(f2, params, tokens)
+    print(f"grad attn={impl}: {dt*1e3:.1f} ms")
+
+# 7. adamw update alone
+opt = optax.adamw(3e-4, weight_decay=0.01)
+opt_state = opt.init(params)
+g = jax.tree.map(jnp.ones_like, params)
+f_opt = jax.jit(lambda g, s, p: opt.update(g, s, p))
+dt = timeit(f_opt, g, opt_state, params)
+print(f"adamw update: {dt*1e3:.1f} ms")

@@ -1,0 +1,38 @@
+"""True MXU rate: chained matmuls inside one jit (amortize dispatch)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+PEAK = 197e12
+K = 20
+
+
+def rate(name, make_fn, flops_per_iter):
+    f = jax.jit(make_fn)
+    out = f()
+    float(jnp.sum(out.astype(jnp.float32)))
+    t0 = time.perf_counter()
+    out = f()
+    float(jnp.sum(out.astype(jnp.float32)))
+    dt = time.perf_counter() - t0
+    print(f"{name}: {K*flops_per_iter/dt/PEAK:.3f} of peak ({dt*1e3:.1f} ms for {K} iters)")
+
+
+def chain(m, n, k, dtype=jnp.bfloat16, out_dtype=None):
+    def fn():
+        a = jnp.ones((m, k), dtype)
+        b = jnp.ones((k, n), dtype)
+        def body(i, acc):
+            y = jax.lax.dot(a, b, preferred_element_type=out_dtype or dtype)
+            return acc + jnp.sum(y.astype(jnp.float32))
+        return jax.lax.fori_loop(0, K, body, jnp.float32(0.0))
+    return fn
+
+
+rate("square 4096 bf16", chain(4096, 4096, 4096), 2 * 4096**3)
+rate("square 8192 bf16", chain(8192, 8192, 8192), 2 * 8192**3)
+rate("head 32768x768x50304 bf16->f32", chain(32768, 50304, 768, out_dtype=jnp.float32), 2 * 32768 * 768 * 50304)
+rate("mlp 32768x768x3072 bf16", chain(32768, 3072, 768), 2 * 32768 * 768 * 3072)
+rate("mlp2 32768x3072x768 bf16", chain(32768, 768, 3072), 2 * 32768 * 768 * 3072)
+rate("qkv 32768x768x2304 bf16", chain(32768, 2304, 768), 2 * 32768 * 768 * 2304)

@@ -1,0 +1,40 @@
+"""True MXU rate with loop-carried dependence (no hoisting)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+PEAK = 197e12
+K = 20
+
+
+def rate(name, m, n, k, dtype=jnp.bfloat16, out_dtype=None):
+    def fn():
+        a0 = jnp.ones((m, k), dtype)
+        b = jnp.ones((k, n), dtype)
+
+        def body(i, a):
+            y = jax.lax.dot(a, b, preferred_element_type=out_dtype or dtype)
+            # feed back a sliver of y so the loop can't be hoisted
+            return a + (y[:, :1] * 1e-30).astype(dtype)
+
+        a = jax.lax.fori_loop(0, K, body, a0)
+        return jnp.sum(a.astype(jnp.float32))
+
+    f = jax.jit(fn)
+    float(f())
+    t0 = time.perf_counter()
+    float(f())
+    dt = time.perf_counter() - t0
+    flops = 2 * m * n * k
+    print(f"{name}: {K*flops/dt/PEAK:.3f} of peak ({dt/K*1e3:.2f} ms/matmul)")
+
+
+rate("square 4096 bf16", 4096, 4096, 4096)
+rate("square 8192 bf16", 8192, 8192, 8192)
+rate("head 32768x50304x768 ->f32", 32768, 50304, 768, out_dtype=jnp.float32)
+rate("head 32768x50304x768 ->bf16", 32768, 50304, 768)
+rate("mlp 32768x3072x768", 32768, 3072, 768)
+rate("mlp2 32768x768x3072", 32768, 768, 3072)
+rate("qkv 32768x2304x768", 32768, 2304, 768)
+rate("f32 square 4096", 4096, 4096, 4096, dtype=jnp.float32)

@@ -1,0 +1,39 @@
+"""MXU rate via chained matmul pairs: a->(a@b)->((a@b)@c) loop-carried."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+PEAK = 197e12
+K = 10
+
+
+def rate(name, m, n, k, dtype=jnp.bfloat16):
+    def fn():
+        a0 = (jnp.ones((m, k), dtype) * 0.001).astype(dtype)
+        b = (jnp.ones((k, n), dtype) * 0.001).astype(dtype)
+        c = (jnp.ones((n, k), dtype) * 0.001).astype(dtype)
+
+        def body(i, a):
+            y = jax.lax.dot(a, b, preferred_element_type=dtype)
+            return jax.lax.dot(y, c, preferred_element_type=dtype)
+
+        a = jax.lax.fori_loop(0, K, body, a0)
+        return jnp.sum(a.astype(jnp.float32))
+
+    f = jax.jit(fn)
+    float(f())
+    t0 = time.perf_counter()
+    float(f())
+    dt = time.perf_counter() - t0
+    flops = 4 * m * n * k * K
+    print(f"{name}: {flops/dt/PEAK:.3f} of peak ({dt/(2*K)*1e3:.2f} ms/matmul)")
+
+
+rate("square 4096", 4096, 4096, 4096)
+rate("square 8192", 8192, 8192, 8192)
+rate("head-ish 32768x50304x768", 32768, 50304, 768)
+rate("mlp 32768x3072x768", 32768, 3072, 768)
+rate("qkv 32768x2304x768", 32768, 2304, 768)
+rate("square 2048", 2048, 2048, 2048)
+rate("f32 4096", 4096, 4096, 4096, dtype=jnp.float32)

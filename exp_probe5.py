@@ -1,0 +1,51 @@
+"""MXU rate, floor-corrected: many chained pairs inside one jit."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+PEAK = 197e12
+
+
+def measure(name, m, n, k, K, dtype=jnp.bfloat16):
+    def fn():
+        a0 = (jnp.ones((m, k), dtype) * 0.001).astype(dtype)
+        b = (jnp.ones((k, n), dtype) * 0.001).astype(dtype)
+        c = (jnp.ones((n, k), dtype) * 0.001).astype(dtype)
+
+        def body(i, a):
+            y = jax.lax.dot(a, b, preferred_element_type=dtype)
+            y = jnp.maximum(y, 0)  # defeat dot reassociation/hoisting
+            return jax.lax.dot(y, c, preferred_element_type=dtype)
+
+        a = jax.lax.fori_loop(0, K, body, a0)
+        return jnp.sum(a.astype(jnp.float32))
+
+    f = jax.jit(fn)
+    float(f())
+    t0 = time.perf_counter()
+    float(f())
+    dt = time.perf_counter() - t0
+    return dt, 4 * m * n * k * K
+
+
+# floor: trivial computation
+def floor_fn():
+    return jnp.sum(jnp.ones((8, 128), jnp.float32))
+ff = jax.jit(floor_fn)
+float(ff())
+t0 = time.perf_counter()
+float(ff())
+floor = time.perf_counter() - t0
+print(f"dispatch+sync floor: {floor*1e3:.1f} ms")
+
+for name, m, n, k, K in [
+    ("square 4096", 4096, 4096, 4096, 200),
+    ("square 8192", 8192, 8192, 8192, 50),
+    ("head 32768x50304x768", 32768, 50304, 768, 25),
+    ("mlp 32768x3072x768", 32768, 3072, 768, 200),
+    ("qkv 32768x2304x768", 32768, 2304, 768, 200),
+]:
+    dt, flops = measure(name, m, n, k, K)
+    eff = flops / (dt - floor) / PEAK
+    print(f"{name}: {eff:.3f} of peak ({(dt-floor)/(2*K)*1e3:.2f} ms/matmul, total {dt*1e3:.0f} ms)")

@@ -1,0 +1,47 @@
+"""End-to-end step timing, 30 steps, for config variants."""
+import dataclasses
+import sys
+import time
+
+import jax
+import optax
+
+from ray_tpu.models import gpt2
+
+PEAK = 197e12
+
+
+def run(name, cfg, batch=32, seq=1024, steps=30):
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, dtype="int32"
+    )
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    try:
+        params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        dt = time.perf_counter() - t0
+    except Exception as e:
+        print(f"{name}: FAILED {type(e).__name__}: {str(e)[:150]}")
+        return
+    tps = batch * seq * steps / dt
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    mfu = tps * 6.0 * n_params / PEAK
+    print(f"{name}: {tps:,.0f} tok/s  mfu={mfu:.4f}  loss={float(loss):.3f}")
+
+
+base = dataclasses.replace(gpt2.CONFIGS["gpt2-small"], attn_impl="flash")
+variants = {
+    "chunk0  remat-full": dataclasses.replace(base, remat=True, loss_chunk=0),
+    "chunk0  remat-dotsv": dataclasses.replace(base, remat=True, remat_policy="dots_saveable", loss_chunk=0),
+    "chunk128 remat-dotsv": dataclasses.replace(base, remat=True, remat_policy="dots_saveable", loss_chunk=128),
+}
+which = sys.argv[1:] or list(variants)
+for name in which:
+    run(name, variants[name])

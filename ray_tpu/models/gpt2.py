@@ -24,6 +24,7 @@ from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 
 from ray_tpu.ops.attention import attention as attention_op
@@ -44,6 +45,11 @@ class GPT2Config:
     remat_policy: str = "full"
     attn_impl: str = "reference"  # reference | flash | ring
     cp_axis: Optional[str] = None  # mesh axis name when attn_impl="ring"
+    # Cross-entropy in T-chunks of this many tokens: the [B,T,V] fp32
+    # logits tensor (6.6GB for gpt2-small at B=32,T=1024) never
+    # materializes — each chunk's logits are recomputed in the backward
+    # pass. 0 disables chunking.
+    loss_chunk: int = 128
 
     @property
     def head_dim(self) -> int:
@@ -139,6 +145,9 @@ def _block(x, layer, cfg: GPT2Config):
     att = attention_op(
         q, k, v, causal=True, impl=cfg.attn_impl, axis_name=cfg.cp_axis
     )
+    # checkpointable under the "dots+attn" remat policy: saving the
+    # attention output avoids re-running the flash kernel in the backward
+    att = jax.ad_checkpoint.checkpoint_name(att, "attn_out")
     att = (
         jnp.einsum("bthn,hnd->btd", att, layer["attn"]["proj"]["kernel"].astype(dt))
         + layer["attn"]["proj"]["bias"].astype(dt)
@@ -157,8 +166,8 @@ def _block(x, layer, cfg: GPT2Config):
     return x + h
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, padded_vocab] (fp32)."""
+def backbone(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> final hidden states [B, T, D] (compute dtype)."""
     B, T = tokens.shape
     dt = cfg.dtype
     x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:T][None]
@@ -170,9 +179,24 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.A
         policy = None
         if cfg.remat_policy == "dots":
             policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif cfg.remat_policy == "dots_saveable":
+            # Save every matmul output and the attention output; recompute
+            # only cheap elementwise ops (LN, gelu, bias) in the backward.
+            # ~6GB of residuals at gpt2-small B=32,T=1024 — the right
+            # trade on a 16GB chip, vs "full" re-running every block fwd.
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_saveable,
+                jax.checkpoint_policies.save_only_these_names("attn_out"),
+            )
         body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(body, x, params["blocks"])
-    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, padded_vocab] (fp32)."""
+    x = backbone(params, tokens, cfg)
+    dt = cfg.dtype
     # tied LM head: bf16 operands on the MXU, fp32 accumulation → fp32
     # logits for a stable softmax without paying the 8x fp32-matmul tax
     return jnp.einsum(
@@ -181,16 +205,50 @@ def forward(params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config) -> jax.A
     )
 
 
-def loss_fn(params, tokens, cfg: GPT2Config) -> jax.Array:
-    """Next-token cross-entropy; masks padded-vocab logits."""
-    logits = forward(params, tokens[:, :-1], cfg)
-    targets = tokens[:, 1:]
+def _chunk_nll(x_chunk, targets_chunk, wte, cfg: GPT2Config) -> jax.Array:
+    """Cross-entropy over one T-chunk; returns summed NLL (fp32 scalar)."""
+    logits = jnp.einsum(
+        "bcd,vd->bcv", x_chunk, wte,
+        preferred_element_type=jnp.float32,
+    )
     if cfg.padded_vocab != cfg.vocab_size:
         pad_mask = jnp.arange(cfg.padded_vocab) >= cfg.vocab_size
         logits = jnp.where(pad_mask[None, None], -1e30, logits)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    nll = -jnp.take_along_axis(logp, targets_chunk[..., None], axis=-1)[..., 0]
+    return nll.sum()
+
+
+def loss_fn(params, tokens, cfg: GPT2Config) -> jax.Array:
+    """Next-token cross-entropy; masks padded-vocab logits.
+
+    With cfg.loss_chunk > 0 the head runs per T-chunk under jax.checkpoint:
+    peak memory holds one [B, C, V] logits block instead of [B, T, V], and
+    the backward pass recomputes each chunk's logits instead of re-reading
+    a giant fp32 tensor from HBM (bandwidth ≫ the recompute FLOPs here).
+    """
+    x = backbone(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    B, T, D = x.shape
+    dt = cfg.dtype
+    wte = params["wte"].astype(dt)
+    C = cfg.loss_chunk
+    if C <= 0 or T % C != 0:
+        total = _chunk_nll(x, targets, wte, cfg)
+        return total / (B * T)
+
+    nC = T // C
+    xs = jnp.moveaxis(x.reshape(B, nC, C, D), 1, 0)        # [nC, B, C, D]
+    ts = jnp.moveaxis(targets.reshape(B, nC, C), 1, 0)     # [nC, B, C]
+
+    def chunk_body(acc, xt):
+        xc, tc = xt
+        return acc + _chunk_nll(xc, tc, wte, cfg), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(chunk_body, prevent_cse=False), jnp.float32(0.0), (xs, ts)
+    )
+    return total / (B * T)
 
 
 def make_train_step(cfg: GPT2Config, optimizer):

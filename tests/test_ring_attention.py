@@ -60,6 +60,39 @@ def test_ring_gradients_match(mesh):
         )
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_multiblock(cpu_mesh_devices, causal, monkeypatch):
+    """T > block size: exercises the K-blocked online-softmax accumulation
+    across multiple (iq, ik) tiles, incl. causal tile skipping."""
+    import jax
+    import jax.numpy as jnp
+
+    # force 4x4 tiles (default bk would cover T in one block -> one-shot path)
+    monkeypatch.setenv("RT_FLASH_BQ", "256")
+    monkeypatch.setenv("RT_FLASH_BK", "256")
+
+    from ray_tpu.ops.attention import _reference_attention
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    B, T, H, D = 1, 1024, 1, 64  # 2x2 tile grid at block 512
+    q, k, v = _rand_qkv((B, T, H, D), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(_reference_attention(q, k, v, causal)),
+        np.asarray(flash_attention(q, k, v, causal)),
+        rtol=1e-5, atol=1e-5,
+    )
+    g1 = jax.grad(
+        lambda q, k, v: (_reference_attention(q, k, v, causal) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, causal) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
 def test_flash_attention_cpu_interpret(cpu_mesh_devices):
     """Pallas flash kernel (interpret mode) vs reference, fwd + bwd."""
     import jax
