@@ -232,9 +232,33 @@ class ControlStore:
                 if a.get("node_id") == node_id
                 and a["state"] in (ActorState.ALIVE, ActorState.PENDING_CREATION)
             ]
+            # PGs with a bundle on the dead node drop ONLY the lost bundle
+            # locations and go back to PENDING for partial re-placement
+            # (reference: GcsPlacementGroupManager reschedules on node
+            # death); survivors' bundles — and the actors in them — keep
+            # running. Without this, leases against the PG fail forever
+            # with "bundle not found".
+            replaced_pgs = []
+            for pg in self._pgs.values():
+                if pg["state"] != PGState.CREATED:
+                    continue
+                lost = [
+                    i for i, nid in pg["bundle_locations"].items()
+                    if nid == node_id
+                ]
+                if lost:
+                    for i in lost:
+                        del pg["bundle_locations"][i]
+                    pg["state"] = PGState.PENDING
+                    replaced_pgs.append(pg["pg_id"])
         self.publish("node", {"event": "removed", "node_id": node_id, "reason": reason})
         for actor in affected_actors:
             self._on_actor_worker_lost(actor["actor_id"], f"node died: {reason}")
+        for pg_id in replaced_pgs:
+            threading.Thread(
+                target=self._schedule_pg, args=(pg_id,),
+                name=f"cs-resched-pg-{pg_id[:8]}", daemon=True,
+            ).start()
 
     # ------------------------------------------------------------------
     # jobs
@@ -579,6 +603,14 @@ class ControlStore:
         return True
 
     def _schedule_pg(self, pg_id: str) -> None:
+        """Place (or re-place) a PG's bundles via 2PC.
+
+        Handles partial placement: only indices absent from
+        bundle_locations are placed, so node-death recovery re-places the
+        lost bundles while surviving bundles (and the actors in them) keep
+        running — mirroring the reference GcsPlacementGroupManager's
+        rescheduling of individual bundles.
+        """
         backoff = 0.05
         while not self._stopped.is_set():
             with self._lock:
@@ -587,17 +619,35 @@ class ControlStore:
                     return
                 bundles = pg["bundles"]
                 strategy = pg["strategy"]
+                locations = {int(k): v for k, v in pg["bundle_locations"].items()}
                 view = self._cluster_view_locked()
-            placement = scheduling.place_bundles(view, bundles, strategy)
-            if placement is None:
+            missing = [i for i in range(len(bundles)) if i not in locations]
+            if not missing:
+                with self._lock:
+                    pg = self._pgs.get(pg_id)
+                    if pg is None or pg["state"] == PGState.REMOVED:
+                        return
+                    pg["state"] = PGState.CREATED
+                self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.CREATED})
+                return
+            place_view = view
+            if strategy == "STRICT_SPREAD" and locations:
+                survivors = set(locations.values())
+                place_view = {
+                    nid: n for nid, n in view.items() if nid not in survivors
+                }
+            sub = scheduling.place_bundles(
+                place_view, [bundles[i] for i in missing], strategy
+            )
+            if sub is None:
                 time.sleep(min(backoff, 1.0))
                 backoff = min(backoff * 2, 1.0)
                 continue
+            placement = {missing[pos]: nid for pos, nid in sub.items()}
             # Phase 1: PREPARE on every involved agent.
             by_node: Dict[str, List[int]] = {}
             for idx, node_id in placement.items():
                 by_node.setdefault(node_id, []).append(idx)
-            prepared: List[Tuple[str, List[int]]] = []
             ok = True
             for node_id, idxs in by_node.items():
                 addr = view[node_id]["address"]
@@ -608,26 +658,23 @@ class ControlStore:
                     )
                 except RpcError:
                     res = False
-                if res:
-                    prepared.append((node_id, idxs))
-                else:
+                if not res:
                     ok = False
                     break
             if not ok:
-                # roll back prepared nodes
-                for node_id, idxs in prepared:
-                    try:
-                        self._agents.get(view[node_id]["address"]).call_oneway(
-                            "return_bundles", pg_id=pg_id
-                        )
-                    except RpcError:
-                        pass
+                # Roll back EVERY node in the attempted placement (by its
+                # attempted indices), not just the ones that acked prepare:
+                # a node whose prepare reply was lost may still hold the
+                # reservation, and return_bundles on a node that never
+                # prepared those indices is a no-op. Synchronous call so a
+                # retried placement can't race its own rollback.
+                self._rollback_bundles(view, by_node, pg_id)
                 time.sleep(min(backoff, 1.0))
                 backoff = min(backoff * 2, 1.0)
                 continue
             # Phase 2: COMMIT. A node that misses COMMIT would refuse
             # bundle leases forever (raylet requires state=="committed"),
-            # so any commit failure rolls the whole PG back and re-places.
+            # so any commit failure rolls this placement back and retries.
             commit_ok = True
             for node_id, idxs in by_node.items():
                 try:
@@ -640,13 +687,7 @@ class ControlStore:
                     logger.warning("pg %s commit failed on %s", pg_id[:8], node_id[:8])
                     commit_ok = False
             if not commit_ok:
-                for node_id, idxs in by_node.items():
-                    try:
-                        self._agents.get(view[node_id]["address"]).call_oneway(
-                            "return_bundles", pg_id=pg_id
-                        )
-                    except RpcError:
-                        pass
+                self._rollback_bundles(view, by_node, pg_id)
                 time.sleep(min(backoff, 1.0))
                 backoff = min(backoff * 2, 1.0)
                 continue
@@ -654,10 +695,21 @@ class ControlStore:
                 pg = self._pgs.get(pg_id)
                 if pg is None:
                     return
-                pg["state"] = PGState.CREATED
-                pg["bundle_locations"] = placement
-            self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.CREATED})
-            return
+                pg["bundle_locations"].update(placement)
+            # loop once more: recompute missing (usually empty -> CREATED)
+
+    def _rollback_bundles(
+        self, view, by_node: Dict[str, List[int]], pg_id: str
+    ) -> None:
+        """Synchronously return the given bundle indices on each node (a
+        one-way send could race a subsequent re-placement's prepare)."""
+        for node_id, idxs in by_node.items():
+            try:
+                self._agents.get(view[node_id]["address"]).call(
+                    "return_bundles", pg_id=pg_id, idxs=idxs
+                )
+            except RpcError:
+                pass
 
     def rpc_get_placement_group(self, conn, pg_id: str):
         with self._lock:

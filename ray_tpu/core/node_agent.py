@@ -437,6 +437,8 @@ class NodeAgent:
             return "pending"
         if not pg:
             return None
+        if pg.get("state") == "REMOVED":
+            return None  # removed PG must error out, not retry forever
         locs = pg.get("bundle_locations") or {}
         if not locs:
             return "pending"
@@ -445,8 +447,14 @@ class NodeAgent:
             node_id = locs.get(idx, locs.get(str(idx)))
         elif locs:
             node_id = next(iter(locs.values()))
-        if node_id is None or node_id not in view:
+        if node_id is None:
             return None
+        if node_id not in view:
+            # Bundle host absent from the alive-node view: either a
+            # heartbeat blip or a real death (in which case the control
+            # store re-places the PG, _mark_node_dead). Either way the
+            # right answer is "retry", not a permanent "bundle not found".
+            return "pending"
         return {"node_id": node_id, "address": view[node_id]["address"]}
 
     def _pick_target_node(self, resources, strategy):
@@ -468,14 +476,36 @@ class NodeAgent:
 
     def rpc_prepare_bundles(self, conn, pg_id: str, bundles: Dict[int, Dict[str, float]]):
         with self._lock:
+            bundles = {int(i): dict(b) for i, b in bundles.items()}
             existing = self._bundles.get(pg_id)
             if existing is not None:
-                # Idempotent retry only if it's the same reservation; a
-                # record with a different bundle set must NOT be resurrected.
-                return existing["bundles"] == {
-                    int(i): dict(b) for i, b in bundles.items()
-                }
-            need: Dict[str, float] = {}
+                if existing["state"] == "prepared":
+                    # Idempotent retry only if it's the same reservation; a
+                    # record with a different bundle set must NOT be
+                    # resurrected.
+                    return existing["bundles"] == bundles
+                # Committed record: a PG re-placement after node death may
+                # land the lost bundles on a node already hosting surviving
+                # bundles. Stage the new indices; commit merges them.
+                staged = existing.get("staged") or {}
+                if staged:
+                    return staged == bundles  # idempotent retry
+                if any(i in existing["bundles"] for i in bundles):
+                    return False  # overlaps committed indices: invalid add
+                need: Dict[str, float] = {}
+                for b in bundles.values():
+                    for k, v in b.items():
+                        need[k] = need.get(k, 0.0) + v
+                if not all(
+                    self.resources_available.get(k, 0.0) >= v
+                    for k, v in need.items()
+                ):
+                    return False
+                for k, v in need.items():
+                    self.resources_available[k] -= v
+                existing["staged"] = bundles
+                return True
+            need = {}
             for b in bundles.values():
                 for k, v in b.items():
                     need[k] = need.get(k, 0.0) + v
@@ -485,8 +515,8 @@ class NodeAgent:
                 self.resources_available[k] -= v
             self._bundles[pg_id] = {
                 "state": "prepared",
-                "bundles": {int(i): dict(b) for i, b in bundles.items()},
-                "available": {int(i): dict(b) for i, b in bundles.items()},
+                "bundles": {i: dict(b) for i, b in bundles.items()},
+                "available": {i: dict(b) for i, b in bundles.items()},
             }
             return True
 
@@ -495,29 +525,49 @@ class NodeAgent:
             rec = self._bundles.get(pg_id)
             if rec is None:
                 return False
+            for i, b in (rec.pop("staged", None) or {}).items():
+                rec["bundles"][i] = dict(b)
+                rec["available"][i] = dict(b)
             rec["state"] = "committed"
             self._cv.notify_all()
             return True
 
-    def rpc_return_bundles(self, conn, pg_id: str):
-        # Any lease granted against this PG is void — the group never fully
-        # committed (or is being removed) — so the worker holding it is
-        # killed and its caller retries against the re-placed PG. (The
-        # reference likewise kills workers using a removed PG's bundles.)
+    def rpc_return_bundles(self, conn, pg_id: str, idxs: Optional[List[int]] = None):
+        """Return bundle reservations to the node pool.
+
+        idxs=None: full teardown (PG removed / total rollback). idxs given:
+        partial rollback of a re-placement — only those bundle indices are
+        returned (committed or staged), surviving bundles keep running.
+        Any lease granted against a returned bundle is void — the worker
+        holding it is killed and its caller retries against the re-placed
+        PG (the reference likewise kills workers using removed bundles).
+        """
         doomed = []
         with self._lock:
-            rec = self._bundles.pop(pg_id, None)
+            rec = self._bundles.get(pg_id)
             if rec is None:
                 return True
+            staged = rec.get("staged") or {}
+            if idxs is None:
+                idx_set = set(rec["bundles"]) | set(staged)
+            else:
+                idx_set = {int(i) for i in idxs}
             for lease_id, info in list(self._leases.items()):
-                if info.get("bundle") and info["bundle"][0] == pg_id:
+                b = info.get("bundle")
+                if b and b[0] == pg_id and b[1] in idx_set:
                     self._leases.pop(lease_id, None)
                     w = self._workers.pop(info["worker_id"], None)
                     if w is not None:
                         doomed.append(w)
-            for b in rec["bundles"].values():
-                for k, v in b.items():
+            for i in idx_set:
+                spec = rec["bundles"].pop(i, None) or staged.pop(i, None)
+                if spec is None:
+                    continue
+                rec["available"].pop(i, None)
+                for k, v in spec.items():
                     self.resources_available[k] = self.resources_available.get(k, 0.0) + v
+            if not rec["bundles"] and not staged:
+                self._bundles.pop(pg_id, None)
             self._cv.notify_all()
         for w in doomed:
             self._terminate_worker(w)

@@ -38,6 +38,10 @@ class ShmObjectStore:
         self._prefix = os.path.join(
             _SHM_DIR, f"rtshm_{session_id[:8]}_{node_id_hex[:8]}"
         )
+        # For validating peer-supplied paths: resolve symlinks once so the
+        # comparison works even when the shm dir itself is a symlink.
+        self._real_dir = os.path.realpath(_SHM_DIR)
+        self._base_prefix = os.path.basename(self._prefix)
         self._capacity = capacity_bytes
         self._used = 0
         self._lock = threading.Lock()
@@ -110,11 +114,25 @@ class ShmObjectStore:
 
     def read_chunk(self, path: str, offset: int, length: int) -> Optional[bytes]:
         """Read a byte range of a sealed segment (serving cross-node pulls).
-        Only paths created by this store are readable."""
-        if not path.startswith(self._prefix):
-            raise ValueError(f"path {path} is not in this store")
+
+        Only segments actually created by this store are readable: a bare
+        prefix check would let a crafted '<prefix>x/../../etc/passwd' path
+        escape, so resolve the path and require it to name a tracked
+        object (O(1): the oid is the path suffix). A well-formed path whose
+        object was deleted mid-transfer returns None — the puller maps that
+        to ObjectLostError, same as a vanished segment."""
+        real = os.path.realpath(path)
+        base = os.path.basename(real)
+        marker = self._base_prefix + "_"
+        if os.path.dirname(real) != self._real_dir or not base.startswith(marker):
+            raise ValueError(f"path {path} is not an object in this store")
+        oid_hex = base[len(marker):]
+        with self._lock:
+            entry = self._objects.get(oid_hex)
+        if entry is None or not entry[2]:
+            return None  # deleted (or never sealed): lost, not an attack
         try:
-            fd = os.open(path, os.O_RDONLY)
+            fd = os.open(entry[0], os.O_RDONLY)
         except OSError:
             return None
         try:
@@ -253,8 +271,9 @@ class PlasmaValue:
     """Marker stored in a memory store meaning 'value lives in shm'.
 
     Carries the hosting node agent's address so any process can free the
-    segment (same-host mmap covers reads; cross-host pull is the object
-    manager's job in a later layer)."""
+    segment; same-host readers mmap the path directly, cross-host readers
+    pull chunks through the hosting agent (worker.py _pull_remote_object /
+    node_agent rpc_read_object_chunk)."""
 
     __slots__ = ("path", "size", "agent_address")
 

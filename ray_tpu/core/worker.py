@@ -9,9 +9,11 @@ actor_task_submitter.h with per-caller ordering) and task execution
 
 Ownership model: the process that creates an object (by put or by task
 submission) owns it — stores the value (or its plasma marker), serves
-get_object to borrowers, and decides deletion. Refs escaping the owner
-process pin the object (round-1 simplification of the borrowing protocol;
-full distributed refcount lands with lineage reconstruction).
+get_object to borrowers, and decides deletion. Refs crossing process
+boundaries use the token-based borrow protocol (ReferenceTracker): each
+serialization creates a TTL-bounded in-flight pin at the owner that the
+deserializer consumes into a real borrow, released when the borrower's
+last local ref drops.
 """
 
 from __future__ import annotations
@@ -86,8 +88,10 @@ class ReferenceTracker:
     the owner, tagged with a fresh token. The deserializer's add_borrow
     *consumes* the token — transferring the pin to the borrower — so the
     pin lives exactly as long as the borrow. A ref serialized but never
-    deserialized leaks its one pin (bounded; Ray solves this with
-    task-completion borrow reports — out of scope here).
+    deserialized (e.g. task args whose lease failed) would leak its pin;
+    in-flight pins therefore carry a TTL (config.borrow_pin_ttl_s) and are
+    swept opportunistically on tracker activity — the lightweight stand-in
+    for the reference's task-completion borrow reports.
     """
 
     def __init__(self, worker: "CoreWorker"):
@@ -95,7 +99,9 @@ class ReferenceTracker:
         self._lock = threading.Lock()
         self._local_counts: Dict[ObjectID, int] = {}
         self._borrows: Dict[ObjectID, int] = {}  # owner side: remote borrowers
-        self._escape_tokens: Dict[str, ObjectID] = {}  # owner side: in-flight pins
+        # owner side: in-flight pins, token -> (oid, created_at monotonic)
+        self._escape_tokens: Dict[str, Tuple[ObjectID, float]] = {}
+        self._next_sweep = 0.0
         # Tokens whose consume arrived before their register (one-way RPCs
         # on different sockets have no cross-connection ordering): a later
         # register for one of these must be dropped, not pinned forever.
@@ -129,14 +135,16 @@ class ReferenceTracker:
             self._worker.delete_owned_object(ref.id)
         elif release:
             self._worker.send_release_borrow(ref.owner_address, ref.id, n=release)
+        self.sweep_expired_pins()
 
     def on_serialize(self, ref: ObjectRef, token: str) -> None:
         """A ref is crossing a process boundary: pin the object at the
         owner for the duration of the flight, keyed by token."""
         if self._worker.owns(ref):
             with self._lock:
-                self._escape_tokens[token] = ref.id
+                self._escape_tokens[token] = (ref.id, time.monotonic())
                 self._borrows[ref.id] = self._borrows.get(ref.id, 0) + 1
+            self.sweep_expired_pins()
         else:
             self._worker.send_add_borrow(
                 ref.owner_address, ref.id, register_token=token
@@ -187,8 +195,9 @@ class ReferenceTracker:
                 if register_token in self._consumed_tokens:
                     # The deserializer already took (and counted) this pin.
                     return
-                self._escape_tokens[register_token] = oid
+                self._escape_tokens[register_token] = (oid, time.monotonic())
             self._borrows[oid] = self._borrows.get(oid, 0) + 1
+        self.sweep_expired_pins()
 
     def owner_release_borrow(self, oid: ObjectID, n: int = 1) -> None:
         delete = False
@@ -205,6 +214,24 @@ class ReferenceTracker:
             # hook (maybe_delete_unreferenced at _store_task_reply) catches
             # the release-before-store ordering.
             self._worker.delete_owned_object(oid)
+
+    def sweep_expired_pins(self) -> None:
+        """Release in-flight pins whose token was never consumed within the
+        TTL (serialized-but-never-deserialized refs — lease failures,
+        dropped messages). Rate-limited to one sweep per TTL/4."""
+        ttl = float(config.borrow_pin_ttl_s)
+        now = time.monotonic()
+        expired: List[ObjectID] = []
+        with self._lock:
+            if now < self._next_sweep:
+                return
+            self._next_sweep = now + ttl / 4
+            for token, (oid, created) in list(self._escape_tokens.items()):
+                if now - created > ttl:
+                    del self._escape_tokens[token]
+                    expired.append(oid)
+        for oid in expired:
+            self.owner_release_borrow(oid)
 
     def maybe_delete_unreferenced(self, oid: ObjectID) -> bool:
         """True if nothing (local refs, borrows, in-flight pins) can ever
@@ -281,6 +308,7 @@ class CoreWorker:
         self._cancelled_tasks: set = set()
         # owner side: task_id hex -> worker address currently executing it
         self._inflight_push: Dict[str, str] = {}
+        self._reattach_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # identity / context
@@ -704,6 +732,11 @@ class CoreWorker:
                     "task %s attempt %d/%d failed: %s",
                     spec.name, attempt + 1, attempts, e,
                 )
+                if isinstance(e, RpcConnectionError):
+                    # The failure may be our own node agent dying (a driver
+                    # outlives its node, unlike workers): re-attach to a
+                    # surviving agent before retrying.
+                    self._maybe_reattach_agent()
                 continue
             except TaskError as e:
                 last_error = e
@@ -720,6 +753,48 @@ class CoreWorker:
             )
         for i in range(spec.num_returns):
             self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+
+    def _maybe_reattach_agent(self) -> None:
+        """Driver-only: if our node agent is unreachable, re-attach to a
+        surviving alive node (reference parity gap P14: the remote driver
+        must not die with the node it happened to pick at init)."""
+        if self.mode != "driver":
+            return
+        with self._reattach_lock:
+            try:
+                self.agent.call("store_usage", timeout_s=3.0)
+                return  # agent alive; failure was elsewhere
+            except RpcConnectionError:
+                pass
+            except RpcError:
+                return  # slow, not dead
+            try:
+                view = self.control.call("get_cluster_view", timeout_s=10.0)
+            except RpcError:
+                return
+            for nid, node in view.items():
+                addr = node["address"]
+                if addr == self.node_agent_address:
+                    continue
+                probe = RpcClient(addr, name="driver->agent")
+                try:
+                    probe.call("store_usage", timeout_s=3.0)
+                except RpcError:
+                    probe.close()
+                    continue
+                logger.warning(
+                    "driver re-attaching from dead agent %s to %s",
+                    self.node_agent_address, addr,
+                )
+                old = self.agent
+                self.agent = probe
+                self.node_agent_address = addr
+                self.node_id_hex = nid
+                try:
+                    old.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                return
 
     def _run_task_on_lease(self, spec: TaskSpec, strategy) -> None:
         bundle = None

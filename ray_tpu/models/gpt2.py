@@ -233,13 +233,16 @@ def loss_fn(params, tokens, cfg: GPT2Config) -> jax.Array:
     dt = cfg.dtype
     wte = params["wte"].astype(dt)
     C = cfg.loss_chunk
-    if C <= 0 or T % C != 0:
+    if C <= 0 or T <= C:
         total = _chunk_nll(x, targets, wte, cfg)
         return total / (B * T)
 
-    nC = T // C
-    xs = jnp.moveaxis(x.reshape(B, nC, C, D), 1, 0)        # [nC, B, C, D]
-    ts = jnp.moveaxis(targets.reshape(B, nC, C), 1, 0)     # [nC, B, C]
+    # T rarely divides C (next-token loss makes T = seq-1, e.g. 1023):
+    # scan over the full chunks, then one remainder chunk outside the
+    # scan, so chunking never silently degrades to the [B,T,V] fallback.
+    nC, rem = divmod(T, C)
+    xs = jnp.moveaxis(x[:, : nC * C].reshape(B, nC, C, D), 1, 0)    # [nC, B, C, D]
+    ts = jnp.moveaxis(targets[:, : nC * C].reshape(B, nC, C), 1, 0)  # [nC, B, C]
 
     def chunk_body(acc, xt):
         xc, tc = xt
@@ -248,6 +251,10 @@ def loss_fn(params, tokens, cfg: GPT2Config) -> jax.Array:
     total, _ = jax.lax.scan(
         jax.checkpoint(chunk_body, prevent_cse=False), jnp.float32(0.0), (xs, ts)
     )
+    if rem:
+        total = total + jax.checkpoint(
+            lambda xc, tc: _chunk_nll(xc, tc, wte, cfg), prevent_cse=False
+        )(x[:, nC * C :], targets[:, nC * C :])
     return total / (B * T)
 
 

@@ -108,6 +108,7 @@ config.define("worker_pool_prestart", 0)
 config.define("worker_idle_timeout_s", 600.0)
 config.define("scheduler_spread_threshold", 0.5)
 config.define("task_max_retries", 3)
+config.define("borrow_pin_ttl_s", 600.0)
 config.define("actor_max_restarts", 0)
 config.define("log_to_driver", True)
 config.define("temp_dir", "/tmp/ray_tpu")

@@ -139,3 +139,58 @@ def test_actor_survives_node_death(cluster):
         # actor landed on the survivor; killing the other node must not hurt
         cluster.kill_node(victim)
         assert ray_tpu.get(a.node.remote(), timeout=30) == first_node
+
+
+def test_pg_replaced_after_node_death(cluster):
+    """A PG with a bundle on a dead node is partially re-placed: the lost
+    bundle moves to a live node, surviving bundle locations are untouched,
+    and new leases against the re-placed bundle succeed (reference:
+    GcsPlacementGroupManager reschedules bundles on node death)."""
+    keeper = cluster.add_node(num_cpus=2)
+    victim = cluster.add_node(num_cpus=2)
+    spare = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(20)
+    locs = pg.table()["bundle_locations"]
+    nodes_used = set(locs.values())
+    # kill a node hosting one bundle (pick whichever of the three it is)
+    doomed = next(n for n in (keeper, victim, spare) if n.node_id in nodes_used)
+    survivor_locs = {i: nid for i, nid in locs.items() if nid != doomed.node_id}
+    cluster.kill_node(doomed)
+
+    deadline = time.monotonic() + 60
+    table = None
+    while time.monotonic() < deadline:
+        table = pg.table()
+        if (
+            table["state"] == "CREATED"
+            and doomed.node_id not in set(table["bundle_locations"].values())
+            and len(table["bundle_locations"]) == 2
+        ):
+            break
+        time.sleep(0.3)
+    assert table is not None and table["state"] == "CREATED"
+    new_locs = table["bundle_locations"]
+    assert doomed.node_id not in set(new_locs.values())
+    # surviving bundle kept its location
+    for i, nid in survivor_locs.items():
+        assert new_locs[i] == nid
+
+    @ray_tpu.remote
+    def where():
+        import ray_tpu as rt
+
+        return rt.get_runtime_context().get_node_id()
+
+    for idx in (0, 1):
+        node = ray_tpu.get(
+            where.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=idx
+                )
+            ).remote(),
+            timeout=60,
+        )
+        assert node == new_locs[idx]
