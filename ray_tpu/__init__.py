@@ -39,6 +39,24 @@ from ray_tpu.core.placement import (
 )
 from ray_tpu.core import exceptions
 
+
+def __getattr__(name):
+    # Lazy subpackage access (`ray_tpu.data` after `import ray_tpu`)
+    # without importing heavyweight libraries at top level.
+    if name in ("data", "train", "serve", "tune", "collective"):
+        import importlib
+
+        try:
+            mod = importlib.import_module(f"ray_tpu.{name}")
+        except ImportError as e:
+            # AttributeError keeps hasattr()-style feature probes working.
+            raise AttributeError(
+                f"module 'ray_tpu' has no attribute {name!r}"
+            ) from e
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")
+
 __all__ = [
     "__version__",
     "ObjectRef",

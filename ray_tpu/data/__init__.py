@@ -1,0 +1,35 @@
+"""ray_tpu.data — streaming Dataset library.
+
+Parity target: Ray Data (reference python/ray/data — lazy logical plan,
+streaming executor over the object plane, per-Train-worker iterators).
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMeta
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    range,  # noqa: A004
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "BlockMeta",
+    "DataIterator",
+    "Dataset",
+    "from_items",
+    "from_numpy",
+    "range",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,244 @@
+"""Dataset — the public ray_tpu.data API.
+
+Parity: the reference Dataset (python/ray/data/dataset.py:185): lazy
+logical plan, streaming execution on iteration/consumption, blocks in the
+shared-memory object store. TPU-first: columnar numpy blocks feed
+`iter_batches` exactly-sized host batches ready for `jax.device_put`
+double-buffering (iterator.py), and `shard()` gives each Train worker a
+deterministic 1/n of the stream.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.data import datasource, executor, logical
+from ray_tpu.data.block import Block, BlockAccessor, BlockMeta, build_batches
+from ray_tpu.data.iterator import DataIterator
+
+
+class Dataset:
+    def __init__(
+        self,
+        plan: logical.LogicalPlan,
+        parallelism_hint: int = 4,
+        shard_spec: Optional[Tuple[int, int]] = None,
+    ):
+        self._plan = plan
+        self._parallelism = parallelism_hint
+        self._shard_spec = shard_spec  # (num_shards, index) block filter
+
+    # -- transforms (lazy) ------------------------------------------------
+
+    def _with(self, op: logical.LogicalOp) -> "Dataset":
+        return Dataset(self._plan.with_op(op), self._parallelism, self._shard_spec)
+
+    def map_batches(
+        self,
+        fn: Any,
+        *,
+        batch_size: Optional[int] = None,
+        fn_constructor_args: Tuple = (),
+        concurrency: Optional[int] = None,
+    ) -> "Dataset":
+        return self._with(
+            logical.MapBatches(
+                fn,
+                batch_size=batch_size,
+                fn_constructor_args=fn_constructor_args,
+                concurrency=concurrency,
+            )
+        )
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._with(logical.MapRows(fn))
+
+    def flat_map(self, fn: Callable[[Any], List[Any]]) -> "Dataset":
+        return self._with(logical.FlatMap(fn))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._with(logical.Filter(fn))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(logical.Limit(n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(logical.Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._with(logical.RandomShuffle(seed))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with(logical.Union([o._plan for o in others]))
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """Deterministic 1/num_shards of the block stream (round-robin by
+        block position) — the per-Train-worker split."""
+        if not 0 <= index < num_shards:
+            raise ValueError(f"shard index {index} not in [0, {num_shards})")
+        return Dataset(self._plan, self._parallelism, (num_shards, index))
+
+    # -- execution --------------------------------------------------------
+
+    def _stream_bundles(self) -> Iterator[executor.RefBundle]:
+        it = executor.execute_plan_streaming(self._plan, self._parallelism)
+        if self._shard_spec is None:
+            yield from it
+            return
+        n, idx = self._shard_spec
+        for pos, bundle in enumerate(it):
+            if pos % n == idx:
+                yield bundle
+
+    def iter_blocks(self) -> Iterator[Block]:
+        from ray_tpu.core.api import get
+
+        for ref, _ in self._stream_bundles():
+            yield get(ref)
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        prefetch_batches: int = 2,
+        drop_last: bool = False,
+    ) -> Iterator[Dict[str, np.ndarray]]:
+        return self.iterator().iter_batches(
+            batch_size=batch_size,
+            prefetch_batches=prefetch_batches,
+            drop_last=drop_last,
+        )
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    def take(self, n: int = 20) -> List[Any]:
+        return list(itertools.islice(self.limit(n).iter_rows(), n))
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(m.num_rows for _, m in self._stream_bundles())
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result is backed by block refs in the object
+        store (reference: Dataset.materialize -> MaterializedDataset)."""
+        bundles = list(self._stream_bundles())
+        plan = logical.LogicalPlan([logical.FromBundles(bundles)])
+        return Dataset(plan, self._parallelism)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Materialize and split into n datasets with equal block counts
+        (reference: Dataset.split for per-worker consumption)."""
+        bundles = list(self._stream_bundles())
+        shards: List[List[executor.RefBundle]] = [[] for _ in builtins.range(n)]
+        for pos, bundle in enumerate(bundles):
+            shards[pos % n].append(bundle)
+        return [
+            Dataset(
+                logical.LogicalPlan([logical.FromBundles(s)]), self._parallelism
+            )
+            for s in shards
+        ]
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self._stream_bundles())
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for block in self.iter_blocks():
+            acc = BlockAccessor.for_block(block)
+            if acc.is_columnar:
+                return {k: str(v.dtype) for k, v in block.items()}
+            for row in acc.iter_rows():
+                if isinstance(row, dict):
+                    return {k: type(v).__name__ for k, v in row.items()}
+                return {"item": type(row).__name__}
+        return None
+
+    def __repr__(self):
+        return f"Dataset({self._plan.describe()})"
+
+
+# ---------------------------------------------------------------------------
+# constructors (parity: python/ray/data/read_api.py)
+# ---------------------------------------------------------------------------
+
+
+def range(n: int, *, parallelism: int = 4) -> Dataset:  # noqa: A001
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.Read(datasource.range_tasks(n, parallelism), f"range({n})")]
+        ),
+        parallelism,
+    )
+
+
+def from_items(items: Sequence[Any], *, parallelism: int = 4) -> Dataset:
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.FromBlocks(datasource.from_items_blocks(items, parallelism))]
+        ),
+        parallelism,
+    )
+
+
+def from_numpy(arrays, *, column: str = "data") -> Dataset:
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.FromBlocks(datasource.from_numpy_blocks(arrays, column))]
+        )
+    )
+
+
+def read_text(paths, *, parallelism: int = 4) -> Dataset:
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.Read(datasource.read_text_tasks(paths), "text")]
+        ),
+        parallelism,
+    )
+
+
+def read_json(paths, *, parallelism: int = 4) -> Dataset:
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.Read(datasource.read_json_tasks(paths), "json")]
+        ),
+        parallelism,
+    )
+
+
+def read_csv(paths, *, parallelism: int = 4) -> Dataset:
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.Read(datasource.read_csv_tasks(paths), "csv")]
+        ),
+        parallelism,
+    )
+
+
+def read_numpy(paths, *, parallelism: int = 4) -> Dataset:
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.Read(datasource.read_numpy_tasks(paths), "numpy")]
+        ),
+        parallelism,
+    )
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = 4) -> Dataset:
+    return Dataset(
+        logical.LogicalPlan(
+            [logical.Read(datasource.read_parquet_tasks(paths, columns), "parquet")]
+        ),
+        parallelism,
+    )

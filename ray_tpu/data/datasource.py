@@ -1,0 +1,162 @@
+"""Datasources — read task generators.
+
+Parity: reference datasources (python/ray/data/datasource/,
+read_api.py). Each `read_*` returns a list of zero-arg callables; each
+runs remotely and returns one Block (the reference's ReadTask plays the
+same role). Parquet is gated on pyarrow availability (not part of this
+image's baked-in set) the way the reference gates optional datasources.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.data.block import Block
+
+
+def _split_range(n: int, k: int) -> List[tuple]:
+    k = max(1, min(k, n)) if n else 1
+    bounds = [(i * n) // k for i in range(k + 1)]
+    return [(bounds[i], bounds[i + 1]) for i in range(k)]
+
+
+def range_tasks(n: int, parallelism: int) -> List[Callable[[], Block]]:
+    def make(lo: int, hi: int):
+        def read() -> Block:
+            return {"id": np.arange(lo, hi, dtype=np.int64)}
+
+        return read
+
+    return [make(lo, hi) for lo, hi in _split_range(n, parallelism)]
+
+
+def from_items_blocks(items: Sequence[Any], parallelism: int) -> List[Block]:
+    items = list(items)
+    return [
+        items[lo:hi] for lo, hi in _split_range(len(items), parallelism)
+    ]
+
+
+def from_numpy_blocks(
+    arrays, column: str = "data"
+) -> List[Block]:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    return [{column: np.asarray(a)} for a in arrays]
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(
+                sorted(
+                    os.path.join(p, f)
+                    for f in os.listdir(p)
+                    if os.path.isfile(os.path.join(p, f))
+                )
+            )
+        elif any(c in p for c in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+def read_text_tasks(paths) -> List[Callable[[], Block]]:
+    def make(path: str):
+        def read() -> Block:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                lines = [ln.rstrip("\n") for ln in f]
+            return [{"text": ln} for ln in lines]
+
+        return read
+
+    return [make(p) for p in _expand_paths(paths)]
+
+
+def read_json_tasks(paths) -> List[Callable[[], Block]]:
+    """JSONL files: one object per line."""
+
+    def make(path: str):
+        def read() -> Block:
+            rows = []
+            with open(path, "r", encoding="utf-8") as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if ln:
+                        rows.append(json.loads(ln))
+            return rows
+
+        return read
+
+    return [make(p) for p in _expand_paths(paths)]
+
+
+def read_csv_tasks(paths) -> List[Callable[[], Block]]:
+    def make(path: str):
+        def read() -> Block:
+            import csv
+
+            with open(path, "r", encoding="utf-8", newline="") as f:
+                reader = csv.DictReader(f)
+                rows = list(reader)
+            if not rows:
+                return []
+            cols: dict = {}
+            for k in rows[0]:
+                vals = [r[k] for r in rows]
+                try:
+                    cols[k] = np.asarray([float(v) for v in vals])
+                except (TypeError, ValueError):
+                    cols[k] = np.asarray(vals)
+            return cols
+
+        return read
+
+    return [make(p) for p in _expand_paths(paths)]
+
+
+def read_numpy_tasks(paths) -> List[Callable[[], Block]]:
+    def make(path: str):
+        def read() -> Block:
+            return {"data": np.load(path, allow_pickle=False)}
+
+        return read
+
+    return [make(p) for p in _expand_paths(paths)]
+
+
+def read_parquet_tasks(
+    paths, columns: Optional[List[str]] = None
+) -> List[Callable[[], Block]]:
+    try:
+        import pyarrow.parquet  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "read_parquet requires pyarrow, which is not available in this "
+            "environment"
+        ) from e
+
+    def make(path: str):
+        def read() -> Block:
+            import pyarrow.parquet as pq
+
+            table = pq.read_table(path, columns=columns)
+            return {
+                name: col.to_numpy(zero_copy_only=False)
+                for name, col in zip(table.column_names, table.columns)
+            }
+
+        return read
+
+    return [make(p) for p in _expand_paths(paths)]

@@ -1,0 +1,574 @@
+"""Streaming executor for Dataset pipelines.
+
+Parity: the reference StreamingExecutor
+(python/ray/data/_internal/execution/streaming_executor.py:70 — thread
+loop :336, scheduling step :448) and its operator-selection policy
+(streaming_executor_state.py:639 select_operator_to_run). Blocks flow
+between physical operators as ObjectRefs (payloads stay in the shm store);
+the driver-side loop schedules on BlockMeta only. Backpressure: each
+operator has a bounded submit window, and the consumer-facing output
+queue is bounded — a slow consumer stalls the whole pipeline instead of
+buffering it in memory (the reference's resource_manager/backpressure
+policies, reduced to the two knobs that matter at this scale).
+
+All-to-all boundaries (repartition / random_shuffle) materialize the
+segment and run as driver-coordinated task fan-outs, mirroring the
+reference's AllToAll operators.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.core.api import get, put, remote, wait
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.data import logical
+from ray_tpu.data.block import Block, BlockAccessor, BlockMeta, normalize_batch_output
+
+logger = logging.getLogger(__name__)
+
+# (block_ref, meta) — the currency of the pipeline.
+RefBundle = Tuple[ObjectRef, BlockMeta]
+
+
+# ---------------------------------------------------------------------------
+# remote transforms (registered once; UDFs travel as ObjectRef args)
+# ---------------------------------------------------------------------------
+
+
+@remote
+def _exec_read(read_fn):
+    block = read_fn()
+    return block, BlockMeta.of(block)
+
+
+@remote
+def _apply_block_fn(fn, block):
+    out = fn(block)
+    return out, BlockMeta.of(out)
+
+
+@remote
+def _slice_block(block, start, end):
+    out = BlockAccessor.for_block(block).slice(start, end)
+    return out, BlockMeta.of(out)
+
+
+@remote
+def _concat_slices(slices, *blocks):
+    """slices: [(block_pos, start, end)] into *blocks."""
+    parts = [
+        BlockAccessor.for_block(blocks[pos]).slice(start, end)
+        for pos, start, end in slices
+    ]
+    out = BlockAccessor.concat(parts)
+    return out, BlockMeta.of(out)
+
+
+@remote
+def _shuffle_rows(block, seed):
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    if acc.is_columnar:
+        out: Block = {k: v[perm] for k, v in block.items()}
+    else:
+        out = [block[i] for i in perm]
+    return out, BlockMeta.of(out)
+
+
+@remote
+class _MapWorker:
+    """Actor-pool worker for stateful (callable-class) map_batches UDFs
+    (parity: the reference's ActorPoolMapOperator)."""
+
+    def __init__(self, fn_cls, ctor_args, batch_size):
+        self._fn = fn_cls(*ctor_args)
+        self._batch_size = batch_size
+
+    def apply(self, block):
+        fn = _batched_apply(self._fn, self._batch_size)
+        out = fn(block)
+        return out, BlockMeta.of(out)
+
+
+def _batched_apply(fn: Callable, batch_size: Optional[int]) -> Callable[[Block], Block]:
+    """Apply a batch UDF to a block, re-chunking to batch_size inside the
+    task when requested (keeps the pipeline 1 block in -> 1 block out)."""
+
+    def apply(block: Block) -> Block:
+        acc = BlockAccessor.for_block(block)
+        batch = acc.to_batch()
+        n = acc.num_rows()
+        if not batch_size or n <= batch_size:
+            return normalize_batch_output(fn(batch))
+        outs = []
+        for start in range(0, n, batch_size):
+            sub = {k: v[start : start + batch_size] for k, v in batch.items()}
+            outs.append(normalize_batch_output(fn(sub)))
+        return BlockAccessor.concat(outs)
+
+    return apply
+
+
+# ---------------------------------------------------------------------------
+# physical operators
+# ---------------------------------------------------------------------------
+
+
+class PhysicalOp:
+    def __init__(self, name: str, max_inflight: int):
+        self.name = name
+        self.max_inflight = max_inflight
+        self.inputs: deque = deque()  # RefBundle
+        self.outputs: deque = deque()  # RefBundle
+        # FIFO of (meta_ref, block_ref): outputs are emitted in SUBMISSION
+        # order, not completion order, so the block stream is deterministic
+        # — shard()'s disjoint-coverage guarantee depends on every rank
+        # observing the same order (reference: preserve_order semantics).
+        self.inflight: deque = deque()
+        self.upstream_done = False
+        self.stopped = False  # limit reached / executor shutdown
+
+    def start(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def can_submit(self) -> bool:
+        return (
+            not self.stopped
+            and bool(self.inputs)
+            and len(self.inflight) < self.max_inflight
+        )
+
+    def submit_one(self) -> None:
+        raise NotImplementedError
+
+    def poll(self) -> None:
+        """Move finished tasks (in submission order) to outputs."""
+        while self.inflight:
+            meta_ref, block_ref = self.inflight[0]
+            ready, _ = wait(
+                [meta_ref], num_returns=1, timeout=0, fetch_local=False
+            )
+            if not ready:
+                return  # head still running: later completions wait (FIFO)
+            self.inflight.popleft()
+            meta = get(meta_ref)  # raises if the task failed
+            self.outputs.append((block_ref, meta))
+
+    def done(self) -> bool:
+        return (
+            (self.upstream_done or self.stopped)
+            and not self.inputs
+            and not self.inflight
+        )
+
+    def backlog(self) -> int:
+        return len(self.inputs) + len(self.inflight) + len(self.outputs)
+
+
+class SourceOp(PhysicalOp):
+    """Read tasks or literal/pre-materialized blocks."""
+
+    def __init__(self, source: logical.LogicalOp, max_inflight: int):
+        super().__init__(getattr(source, "name", "Source"), max_inflight)
+        self._read_fns: List[Callable] = []
+        if isinstance(source, logical.Read):
+            self._read_fns = list(source.read_fns)
+        elif isinstance(source, logical.FromBlocks):
+            for b in source.blocks:
+                self.outputs.append((put(b), BlockMeta.of(b)))
+        else:
+            raise TypeError(f"unsupported source {source}")
+        self.upstream_done = True
+
+    def can_submit(self) -> bool:
+        return (
+            not self.stopped
+            and bool(self._read_fns)
+            and len(self.inflight) < self.max_inflight
+        )
+
+    def submit_one(self) -> None:
+        fn = self._read_fns.pop(0)
+        block_ref, meta_ref = _exec_read.options(num_returns=2).remote(fn)
+        self.inflight.append((meta_ref, block_ref))
+
+    def done(self) -> bool:
+        return (
+            (not self._read_fns or self.stopped)
+            and not self.inflight
+        )
+
+
+class FromRefsOp(PhysicalOp):
+    """Source fed by already-materialized RefBundles (segment boundary)."""
+
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__("FromRefs", 1)
+        self.outputs.extend(bundles)
+        self.upstream_done = True
+
+    def can_submit(self) -> bool:
+        return False
+
+    def done(self) -> bool:
+        return True
+
+
+class TaskMapOp(PhysicalOp):
+    """One task per block applying a fused block transform."""
+
+    def __init__(self, name: str, block_fn: Callable[[Block], Block],
+                 max_inflight: int):
+        super().__init__(name, max_inflight)
+        self._fn_ref: Optional[ObjectRef] = None
+        self._block_fn = block_fn
+
+    def start(self) -> None:
+        # Ship the (possibly large) fused closure once, not per task.
+        self._fn_ref = put(self._block_fn)
+
+    def submit_one(self) -> None:
+        block_ref, _ = self.inputs.popleft()
+        out_ref, meta_ref = _apply_block_fn.options(num_returns=2).remote(
+            self._fn_ref, block_ref
+        )
+        self.inflight.append((meta_ref, out_ref))
+
+
+class ActorMapOp(PhysicalOp):
+    """Fixed-size actor pool for stateful UDFs."""
+
+    def __init__(self, op: logical.MapBatches, max_inflight: int):
+        pool_size = op.concurrency or 2
+        super().__init__(op.name, max_inflight=pool_size * 2)
+        self._op = op
+        self._pool_size = pool_size
+        self._actors: List[Any] = []
+        self._actor_load: Dict[int, int] = {}
+
+    def start(self) -> None:
+        for _ in range(self._pool_size):
+            self._actors.append(
+                _MapWorker.remote(
+                    self._op.fn, self._op.fn_constructor_args, self._op.batch_size
+                )
+            )
+        self._actor_load = {i: 0 for i in range(self._pool_size)}
+
+    def close(self) -> None:
+        from ray_tpu.core.api import kill
+
+        for a in self._actors:
+            try:
+                kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def can_submit(self) -> bool:
+        return (
+            not self.stopped
+            and bool(self.inputs)
+            and min(self._actor_load.values(), default=0) < 2
+        )
+
+    def submit_one(self) -> None:
+        block_ref, _ = self.inputs.popleft()
+        idx = min(self._actor_load, key=self._actor_load.get)
+        out_ref, meta_ref = self._actors[idx].apply.options(num_returns=2).remote(
+            block_ref
+        )
+        self._actor_load[idx] += 1
+        self.inflight.append((meta_ref, out_ref, idx))
+
+    def poll(self) -> None:
+        while self.inflight:
+            meta_ref, block_ref, idx = self.inflight[0]
+            ready, _ = wait(
+                [meta_ref], num_returns=1, timeout=0, fetch_local=False
+            )
+            if not ready:
+                return
+            self.inflight.popleft()
+            self._actor_load[idx] -= 1
+            meta = get(meta_ref)
+            self.outputs.append((block_ref, meta))
+
+
+class LimitOp(PhysicalOp):
+    """Streaming row limit; truncates the boundary block remotely and
+    stops the pipeline upstream once satisfied."""
+
+    def __init__(self, n: int):
+        super().__init__(f"Limit[{n}]", max_inflight=1)
+        self.n = n
+        self.emitted = 0
+        self.satisfied = False
+
+    def can_submit(self) -> bool:
+        return not self.stopped and bool(self.inputs) and not self.inflight
+
+    def submit_one(self) -> None:
+        block_ref, meta = self.inputs.popleft()
+        if self.satisfied:
+            return
+        remaining = self.n - self.emitted
+        if meta.num_rows <= remaining:
+            self.emitted += meta.num_rows
+            if self.emitted >= self.n:
+                self.satisfied = True
+            self.outputs.append((block_ref, meta))
+            return
+        out_ref, meta_ref = _slice_block.options(num_returns=2).remote(
+            block_ref, 0, remaining
+        )
+        self.inflight.append((meta_ref, out_ref))
+        self.emitted = self.n
+        self.satisfied = True
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+
+class StreamingExecutor:
+    """Runs one streaming segment (a chain of 1:1 physical operators)."""
+
+    def __init__(
+        self,
+        ops: List[PhysicalOp],
+        out_buffer_blocks: int = 8,
+    ):
+        self._ops = ops
+        self._out: "queue.Queue" = queue.Queue(maxsize=out_buffer_blocks)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def start(self) -> None:
+        for op in self._ops:
+            op.start()
+        self._thread = threading.Thread(
+            target=self._loop, name="data-executor", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        for op in self._ops:
+            op.close()
+
+    def _loop(self) -> None:
+        ops = self._ops
+        try:
+            while not self._stop.is_set():
+                progressed = False
+                for op in ops:
+                    before = len(op.outputs)
+                    op.poll()
+                    progressed |= len(op.outputs) != before
+                # propagate limit-satisfied stop upstream
+                for i, op in enumerate(ops):
+                    if isinstance(op, LimitOp) and op.satisfied:
+                        for up in ops[:i]:
+                            up.stopped = True
+                # move outputs downstream (respecting downstream windows)
+                for i in range(len(ops) - 1):
+                    nxt = ops[i + 1]
+                    while ops[i].outputs and nxt.backlog() < 2 * nxt.max_inflight:
+                        nxt.inputs.append(ops[i].outputs.popleft())
+                        progressed = True
+                    nxt.upstream_done = ops[i].done() and not ops[i].outputs
+                # drain final op into the consumer queue
+                while ops[-1].outputs:
+                    try:
+                        self._out.put(ops[-1].outputs[0], timeout=0.05)
+                        ops[-1].outputs.popleft()
+                        progressed = True
+                    except queue.Full:
+                        break
+                # submit work, downstream-most first (drains the pipeline,
+                # bounding memory — the reference's selection policy)
+                for op in reversed(ops):
+                    if op.can_submit():
+                        op.submit_one()
+                        progressed = True
+                        break
+                if all(op.done() for op in ops) and not any(
+                    op.outputs for op in ops
+                ):
+                    break
+                if not progressed:
+                    self._stop.wait(0.005)
+        except BaseException as e:  # noqa: BLE001 — surface to consumer
+            self._error = e
+        finally:
+            # The sentinel MUST land or the consumer blocks forever on an
+            # exhausted queue; keep trying until delivered or the consumer
+            # abandons us (shutdown sets _stop).
+            while True:
+                try:
+                    self._out.put(None, timeout=0.5)
+                    break
+                except queue.Full:
+                    if self._stop.is_set():
+                        break
+
+    def iter_output(self) -> Iterator[RefBundle]:
+        self.start()
+        try:
+            while True:
+                item = self._out.get()
+                if item is None:
+                    if self._error is not None:
+                        raise self._error
+                    return
+                yield item
+        finally:
+            self.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# plan driver (segments + all-to-all boundaries)
+# ---------------------------------------------------------------------------
+
+
+def _build_segment_ops(
+    seg: List[logical.LogicalOp],
+    input_bundles: Optional[List[RefBundle]],
+    parallelism_hint: int,
+) -> List[PhysicalOp]:
+    stages = logical.fuse_stages(seg)
+    ops: List[PhysicalOp] = []
+    window = max(2, min(8, parallelism_hint))
+    for name, block_fn, info in stages:
+        if "source" in info:
+            src = info["source"]
+            if isinstance(src, logical.FromBundles):
+                ops.append(FromRefsOp(list(src.bundles)))
+            else:
+                ops.append(SourceOp(src, max_inflight=window))
+        elif "limit" in info:
+            ops.append(LimitOp(info["limit"]))
+        elif "map_batches" in info:
+            op = info["map_batches"]
+            if op.is_actor_fn:
+                ops.append(ActorMapOp(op, max_inflight=window))
+            else:
+                ops.append(
+                    TaskMapOp(
+                        op.name,
+                        _batched_apply(op.fn, op.batch_size),
+                        max_inflight=window,
+                    )
+                )
+        else:
+            ops.append(TaskMapOp(name, block_fn, max_inflight=window))
+    if not ops or not isinstance(ops[0], (SourceOp,)):
+        ops.insert(0, FromRefsOp(input_bundles or []))
+    return ops
+
+
+def _apply_boundary(
+    op: logical.LogicalOp, bundles: List[RefBundle]
+) -> List[RefBundle]:
+    if isinstance(op, logical.Repartition):
+        return _repartition(bundles, op.num_blocks)
+    if isinstance(op, logical.RandomShuffle):
+        return _random_shuffle(bundles, op.seed)
+    if isinstance(op, logical.Union):
+        out = list(bundles)
+        for other in op.others:
+            out.extend(execute_plan_materialized(other))
+        return out
+    raise TypeError(f"unsupported boundary op {op}")
+
+
+def _repartition(bundles: List[RefBundle], n: int) -> List[RefBundle]:
+    """Exact-row repartition into n blocks via remote concat tasks."""
+    total = sum(m.num_rows for _, m in bundles)
+    targets = [
+        (j * total) // n for j in range(n + 1)
+    ]  # row offsets of output boundaries
+    # row offsets of input blocks
+    offsets = [0]
+    for _, m in bundles:
+        offsets.append(offsets[-1] + m.num_rows)
+    pending: List[Tuple[ObjectRef, ObjectRef]] = []
+    for j in range(n):
+        lo, hi = targets[j], targets[j + 1]
+        slices: List[Tuple[int, int, int]] = []
+        needed_refs: List[ObjectRef] = []
+        for i, (ref, m) in enumerate(bundles):
+            s = max(lo, offsets[i])
+            e = min(hi, offsets[i + 1])
+            if s < e:
+                slices.append((len(needed_refs), s - offsets[i], e - offsets[i]))
+                needed_refs.append(ref)
+        pending.append(
+            _concat_slices.options(num_returns=2).remote(slices, *needed_refs)
+        )
+    # submit all first, gather metas second: the fan-out runs concurrently
+    return [(ref, get(meta_ref)) for ref, meta_ref in pending]
+
+
+def _random_shuffle(
+    bundles: List[RefBundle], seed: Optional[int]
+) -> List[RefBundle]:
+    """Block-order permutation + per-block row shuffle (the reference's
+    randomize_block_order + local shuffle approximation of a full
+    shuffle; exact all-to-all shuffle costs a materialized transpose)."""
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(bundles))
+    out: List[RefBundle] = []
+    pending: List[Tuple[ObjectRef, ObjectRef]] = []
+    for pos in order:
+        ref, _ = bundles[pos]
+        block_ref, meta_ref = _shuffle_rows.options(num_returns=2).remote(
+            ref, int(rng.integers(0, 2**31))
+        )
+        pending.append((block_ref, meta_ref))
+    for block_ref, meta_ref in pending:
+        out.append((block_ref, get(meta_ref)))
+    return out
+
+
+def execute_plan_streaming(
+    plan: logical.LogicalPlan, parallelism_hint: int = 4
+) -> Iterator[RefBundle]:
+    """Stream the plan's output bundles; only all-to-all boundaries (and
+    the segments before them) materialize."""
+    segments = logical.split_segments(plan)
+    bundles: Optional[List[RefBundle]] = None
+    for seg in segments[:-1]:
+        if len(seg) == 1 and not seg[0].one_to_one:
+            bundles = _apply_boundary(seg[0], bundles or [])
+        else:
+            ops = _build_segment_ops(seg, bundles, parallelism_hint)
+            bundles = list(StreamingExecutor(ops).iter_output())
+    last = segments[-1]
+    if len(last) == 1 and not last[0].one_to_one:
+        yield from _apply_boundary(last[0], bundles or [])
+        return
+    ops = _build_segment_ops(last, bundles, parallelism_hint)
+    yield from StreamingExecutor(ops).iter_output()
+
+
+def execute_plan_materialized(
+    plan: logical.LogicalPlan, parallelism_hint: int = 4
+) -> List[RefBundle]:
+    return list(execute_plan_streaming(plan, parallelism_hint))

@@ -1,0 +1,261 @@
+"""Logical plan for Datasets.
+
+Parity: the reference's lazy logical plan + optimizer
+(python/ray/data/_internal/logical/, optimizer rules optimizers.py:55-92).
+A Dataset holds an immutable chain of logical operators; execution plans
+it into streaming segments (executor.py). The one optimizer rule that
+matters for performance — fusing adjacent one-to-one ops into a single
+task per block, the reference's OperatorFusionRule — is implemented here
+as `fuse_stages`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.data.block import Block, BlockAccessor, normalize_batch_output
+
+
+class LogicalOp:
+    """Base logical operator. one_to_one ops transform one input block to
+    one output block and can be fused; boundary ops (repartition, shuffle,
+    sort) need all upstream blocks."""
+
+    name = "op"
+    one_to_one = True
+
+
+class Read(LogicalOp):
+    """Source: a list of read tasks, each a zero-arg callable returning a
+    Block (runs remotely)."""
+
+    name = "Read"
+
+    def __init__(self, read_fns: List[Callable[[], Block]], source_name: str):
+        self.read_fns = read_fns
+        self.name = f"Read[{source_name}]"
+
+
+class FromBlocks(LogicalOp):
+    """Source: literal blocks already in driver memory (or refs)."""
+
+    name = "FromBlocks"
+
+    def __init__(self, blocks: List[Block]):
+        self.blocks = blocks
+
+
+class FromBundles(LogicalOp):
+    """Source: already-materialized (block_ref, BlockMeta) bundles — the
+    backing of a MaterializedDataset / split shard."""
+
+    name = "FromBundles"
+
+    def __init__(self, bundles: List[Any]):
+        self.bundles = bundles
+
+
+class MapBatches(LogicalOp):
+    name = "MapBatches"
+
+    def __init__(
+        self,
+        fn: Any,  # callable or callable class
+        batch_size: Optional[int] = None,
+        fn_constructor_args: Tuple = (),
+        concurrency: Optional[int] = None,
+        zero_copy_batch: bool = True,
+    ):
+        self.fn = fn
+        self.batch_size = batch_size
+        self.fn_constructor_args = fn_constructor_args
+        self.concurrency = concurrency
+        self.is_actor_fn = isinstance(fn, type)
+        self.name = f"MapBatches({getattr(fn, '__name__', type(fn).__name__)})"
+
+    def make_block_fn(self) -> Callable[[Block], Block]:
+        """Plain-function path: the per-block transform (batch_size=None
+        maps the whole block as one batch — the executor re-chunks blocks
+        when an explicit batch_size is given)."""
+        fn = self.fn
+
+        def apply(block: Block) -> Block:
+            batch = BlockAccessor.for_block(block).to_batch()
+            return normalize_batch_output(fn(batch))
+
+        return apply
+
+
+class MapRows(LogicalOp):
+    name = "Map"
+
+    def __init__(self, fn: Callable[[Any], Any]):
+        self.fn = fn
+        self.name = f"Map({getattr(fn, '__name__', 'fn')})"
+
+    def make_block_fn(self) -> Callable[[Block], Block]:
+        fn = self.fn
+
+        def apply(block: Block) -> Block:
+            rows = [fn(r) for r in BlockAccessor.for_block(block).iter_rows()]
+            if rows and isinstance(rows[0], dict):
+                import numpy as np
+
+                return {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+            return rows
+
+        return apply
+
+
+class FlatMap(LogicalOp):
+    name = "FlatMap"
+
+    def __init__(self, fn: Callable[[Any], List[Any]]):
+        self.fn = fn
+
+    def make_block_fn(self) -> Callable[[Block], Block]:
+        fn = self.fn
+
+        def apply(block: Block) -> Block:
+            rows: List[Any] = []
+            for r in BlockAccessor.for_block(block).iter_rows():
+                rows.extend(fn(r))
+            return rows
+
+        return apply
+
+
+class Filter(LogicalOp):
+    name = "Filter"
+
+    def __init__(self, fn: Callable[[Any], bool]):
+        self.fn = fn
+        self.name = f"Filter({getattr(fn, '__name__', 'fn')})"
+
+    def make_block_fn(self) -> Callable[[Block], Block]:
+        fn = self.fn
+
+        def apply(block: Block) -> Block:
+            acc = BlockAccessor.for_block(block)
+            if acc.is_columnar:
+                import numpy as np
+
+                keep = [
+                    i for i, r in enumerate(acc.iter_rows()) if fn(r)
+                ]
+                idx = np.asarray(keep, dtype=np.int64)
+                return {k: v[idx] for k, v in block.items()}
+            return [r for r in block if fn(r)]
+
+        return apply
+
+
+class Limit(LogicalOp):
+    """Streaming limit: executor stops scheduling upstream work once n
+    rows have been emitted."""
+
+    name = "Limit"
+    one_to_one = True  # truncation handled specially by the executor
+
+    def __init__(self, n: int):
+        self.n = n
+        self.name = f"Limit[{n}]"
+
+
+class Repartition(LogicalOp):
+    name = "Repartition"
+    one_to_one = False
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self.name = f"Repartition[{num_blocks}]"
+
+
+class RandomShuffle(LogicalOp):
+    name = "RandomShuffle"
+    one_to_one = False
+
+    def __init__(self, seed: Optional[int] = None):
+        # Pin the seed at plan-build time: the plan is serialized to every
+        # train worker, and shard()'s disjoint-coverage guarantee requires
+        # all ranks to observe the SAME shuffled block order.
+        if seed is None:
+            import random
+
+            seed = random.randrange(2**31)
+        self.seed = seed
+
+
+class Union(LogicalOp):
+    name = "Union"
+    one_to_one = False
+
+    def __init__(self, others: List["LogicalPlan"]):
+        self.others = others
+
+
+class LogicalPlan:
+    """Immutable op chain; `with_op` returns an extended copy."""
+
+    def __init__(self, ops: List[LogicalOp]):
+        self.ops = ops
+
+    def with_op(self, op: LogicalOp) -> "LogicalPlan":
+        return LogicalPlan(self.ops + [op])
+
+    def describe(self) -> str:
+        return " -> ".join(op.name for op in self.ops)
+
+
+def split_segments(plan: LogicalPlan) -> List[List[LogicalOp]]:
+    """Split the chain at all-to-all boundaries. Each segment streams;
+    boundaries materialize (the reference's streaming executor does the
+    same around AllToAll operators)."""
+    segments: List[List[LogicalOp]] = [[]]
+    for op in plan.ops:
+        if op.one_to_one:
+            segments[-1].append(op)
+        else:
+            segments.append([op])
+            segments.append([])
+    return [s for s in segments if s]
+
+
+def fuse_stages(
+    ops: List[LogicalOp],
+) -> List[Tuple[str, Callable[[Block], Block], Dict[str, Any]]]:
+    """Fuse adjacent plain-function one-to-one ops into single per-block
+    transforms. Actor-based MapBatches and Limit break the fusion chain
+    (they need their own physical operator). Returns a list of
+    (name, block_fn|None, info) physical stage descriptors."""
+    stages: List[Tuple[str, Any, Dict[str, Any]]] = []
+    pending: List[LogicalOp] = []
+
+    def flush():
+        if not pending:
+            return
+        fns = [op.make_block_fn() for op in pending]
+        name = "+".join(op.name for op in pending)
+
+        def fused(block: Block, _fns=tuple(fns)) -> Block:
+            for f in _fns:
+                block = f(block)
+            return block
+
+        stages.append((name, fused, {}))
+        pending.clear()
+
+    for op in ops:
+        if isinstance(op, (Read, FromBlocks, FromBundles)):
+            flush()
+            stages.append((op.name, None, {"source": op}))
+        elif isinstance(op, Limit):
+            flush()
+            stages.append((op.name, None, {"limit": op.n}))
+        elif isinstance(op, MapBatches) and (op.is_actor_fn or op.batch_size):
+            flush()
+            stages.append((op.name, None, {"map_batches": op}))
+        else:
+            pending.append(op)
+    flush()
+    return stages

@@ -23,6 +23,7 @@ class TrainContext:
         run_dir: Optional[str],
         restore_checkpoint: Optional[Checkpoint],
         collective_group: Optional[str],
+        dataset_shards: Optional[Dict[str, Any]] = None,
     ):
         self.world_rank = world_rank
         self.world_size = world_size
@@ -31,6 +32,7 @@ class TrainContext:
         self.run_dir = run_dir
         self.restore_checkpoint = restore_checkpoint
         self.collective_group = collective_group
+        self.dataset_shards = dataset_shards or {}
         self.reports: List[Dict[str, Any]] = []
         self.report_step = 0
 
@@ -54,6 +56,19 @@ class TrainContext:
     def get_experiment_name(self) -> Optional[str]:
         return os.path.basename(self.run_dir) if self.run_dir else None
 
+    def get_dataset_shard(self, name: str = "train"):
+        """This rank's 1/world_size shard of a Dataset passed to the
+        Trainer via datasets= (parity: ray.train.get_dataset_shard,
+        reference v2/_internal/data_integration/). Returns a
+        ray_tpu.data.DataIterator."""
+        ds = self.dataset_shards.get(name)
+        if ds is None:
+            raise KeyError(
+                f"no dataset named {name!r} was passed to the Trainer "
+                f"(have: {sorted(self.dataset_shards)})"
+            )
+        return ds.iterator()
+
 
 def set_context(ctx: Optional[TrainContext]) -> None:
     _local.ctx = ctx
@@ -66,6 +81,10 @@ def get_context() -> TrainContext:
             "ray_tpu.train.get_context() called outside a train worker"
         )
     return ctx
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context().get_dataset_shard(name)
 
 
 def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:

@@ -48,6 +48,7 @@ class TrainController:
         train_loop_config: Optional[Dict[str, Any]],
         use_tpu: bool,
         chips_per_worker: int,
+        dataset_blobs: Optional[List[bytes]] = None,
     ) -> Dict[str, Any]:
         attempt = 0
         last_error: Optional[str] = None
@@ -63,6 +64,7 @@ class TrainController:
                 refs = wg.run(
                     train_fn_blob, train_loop_config,
                     restore.path if restore else None, group_name,
+                    dataset_blobs,
                 )
                 all_reports: List[List[Dict[str, Any]]] = ray_tpu.get(refs)
                 self._register_checkpoints(all_reports[0])

@@ -26,11 +26,23 @@ class DataParallelTrainer:
         train_loop_config: Optional[Dict[str, Any]] = None,
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        dataset_split_mode: str = "materialize",
     ):
         self._train_fn = train_loop_per_worker
         self._train_loop_config = train_loop_config
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        # "materialize": execute the pipeline ONCE on the driver, hand each
+        # rank a FromBundles shard (no duplicated read/preprocess compute;
+        # costs full materialization in the object store).
+        # "reexecute": each rank streams its own execution filtered to
+        # 1/world_size of the block stream (no materialization; read/map
+        # compute runs world_size times).
+        if dataset_split_mode not in ("materialize", "reexecute"):
+            raise ValueError(f"unknown dataset_split_mode {dataset_split_mode!r}")
+        self.dataset_split_mode = dataset_split_mode
 
     def _run_dir(self) -> str:
         base = self.run_config.storage_path or os.path.join(
@@ -40,6 +52,26 @@ class DataParallelTrainer:
         path = os.path.join(base, name)
         os.makedirs(path, exist_ok=True)
         return path
+
+    def _dataset_blobs(self):
+        """Per-rank dataset dicts, sharded driver-side (each rank receives
+        exactly its shard — no shard logic on the worker). dumps_function
+        (cloudpickle + by-value module registration) so UDFs defined in
+        user modules deserialize on workers."""
+        if not self.datasets:
+            return None
+        n = self.scaling_config.num_workers
+        per_rank = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if n <= 1:
+                parts = [ds]
+            elif self.dataset_split_mode == "materialize":
+                parts = ds.split(n)
+            else:
+                parts = [ds.shard(n, i) for i in range(n)]
+            for i in range(n):
+                per_rank[i][name] = parts[i]
+        return [serialization.dumps_function(d) for d in per_rank]
 
     def fit(self) -> Result:
         run_dir = self._run_dir()
@@ -59,6 +91,7 @@ class DataParallelTrainer:
                     self._train_loop_config,
                     self.scaling_config.use_tpu,
                     self.scaling_config.tpu_chips_per_worker,
+                    self._dataset_blobs(),
                 ),
             )
         finally:

@@ -49,6 +49,7 @@ class TrainWorker:
         train_loop_config: Optional[Dict[str, Any]],
         restore_checkpoint_path: Optional[str],
         collective_group: Optional[str],
+        datasets_blob: Optional[bytes] = None,
     ) -> List[Dict[str, Any]]:
         """Execute the user train loop; returns this rank's reports."""
         from ray_tpu.train import context as ctx_mod
@@ -69,6 +70,12 @@ class TrainWorker:
         restore = (
             Checkpoint(restore_checkpoint_path) if restore_checkpoint_path else None
         )
+        # the blob already holds THIS rank's shard (driver-side split)
+        shards = (
+            serialization.loads(datasets_blob)
+            if datasets_blob is not None
+            else None
+        )
         ctx = ctx_mod.TrainContext(
             world_rank=self.rank,
             world_size=self.world_size,
@@ -77,6 +84,7 @@ class TrainWorker:
             run_dir=self.run_dir,
             restore_checkpoint=restore,
             collective_group=collective_group,
+            dataset_shards=shards,
         )
         if restore is not None:
             # continue checkpoint numbering from the restored step so a
@@ -134,10 +142,14 @@ class WorkerGroup:
             w.setup_collectives.remote(group_name) for w in self.workers
         ], timeout=120)
 
-    def run(self, train_fn_blob, config, restore_path, collective_group):
+    def run(self, train_fn_blob, config, restore_path, collective_group,
+            dataset_blobs=None):
         return [
-            w.run.remote(train_fn_blob, config, restore_path, collective_group)
-            for w in self.workers
+            w.run.remote(
+                train_fn_blob, config, restore_path, collective_group,
+                dataset_blobs[i] if dataset_blobs else None,
+            )
+            for i, w in enumerate(self.workers)
         ]
 
     def node_ids(self) -> List[str]:
