@@ -1,0 +1,36 @@
+"""ray_tpu.serve — online model serving.
+
+Parity target: Ray Serve (reference python/ray/serve — controller
+reconciler, per-node HTTP proxies, pow-2 request router, replica
+autoscaling, deployment handles).
+"""
+
+from ray_tpu.serve.api import (
+    DeploymentResponse,
+    Deployment,
+    DeploymentHandle,
+    delete,
+    deployment,
+    get_deployment_handle,
+    proxy_addresses,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.replica import Request
+
+__all__ = [
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "proxy_addresses",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

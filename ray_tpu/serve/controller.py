@@ -1,0 +1,363 @@
+"""Serve controller — desired-state reconciler.
+
+Parity: the reference ServeController actor
+(python/ray/serve/_private/controller.py:123) with its
+DeploymentStateManager reconcile loop (deployment_state.py:2203,3627),
+requests-per-replica autoscaling (autoscaling_policy.py), and replica
+health checking. Routing tables are served with a version number so
+routers poll cheaply (long-poll-lite, reference long_poll.py:253).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.replica import ServeReplica
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+RECONCILE_PERIOD_S = 0.5
+AUTOSCALE_WINDOW_S = 2.0
+
+
+@ray_tpu.remote
+class ServeController:
+    def __init__(self, http_port: Optional[int] = None):
+        # name -> deployment record
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._version = 0
+        self._http_port = http_port
+        self._proxies: Dict[str, Any] = {}  # node_id -> proxy handle
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, name="serve-reconcile", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # control API
+    # ------------------------------------------------------------------
+
+    def deploy(
+        self,
+        name: str,
+        callable_blob: bytes,
+        init_args: tuple,
+        init_kwargs: dict,
+        num_replicas: int,
+        route_prefix: Optional[str],
+        max_concurrency: int,
+        autoscaling: Optional[Dict[str, Any]],
+        resources: Optional[Dict[str, float]],
+    ) -> bool:
+        old_replicas = []
+        with self._lock:
+            existing = self._deployments.get(name)
+            next_replica = 0
+            if existing is not None:
+                # Redeploy: new code/config replaces the old replicas.
+                # Keep the replica counter so actor names never collide,
+                # and kill the old replicas (outside the lock) so the
+                # reconciler starts fresh ones from the new blob.
+                next_replica = existing["next_replica"]
+                old_replicas = list(existing["replicas"].values())
+            self._deployments[name] = {
+                "name": name,
+                "callable_blob": callable_blob,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "target_replicas": num_replicas,
+                "route_prefix": route_prefix or f"/{name}",
+                "max_concurrency": max_concurrency,
+                # {min_replicas, max_replicas, target_ongoing_requests}
+                "autoscaling": autoscaling,
+                "resources": resources or {},
+                "replicas": {},  # replica_id -> {handle, healthy}
+                "stats": {},  # replica_id -> last stats
+                "next_replica": next_replica,
+                "deleting": False,
+            }
+            self._version += 1
+        for rec in old_replicas:
+            self._kill_silently(rec["handle"])
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is None:
+                return False
+            dep["deleting"] = True
+            dep["target_replicas"] = 0
+            self._version += 1
+        return True
+
+    def get_routing_table(self, known_version: int = -1, wait_s: float = 0.0):
+        """Routing table + version. If known_version is current, optionally
+        wait up to wait_s for a change (long-poll-lite)."""
+        deadline = time.monotonic() + wait_s
+        while True:
+            with self._lock:
+                if self._version != known_version:
+                    table = {
+                        name: {
+                            "route_prefix": dep["route_prefix"],
+                            "replicas": [
+                                {
+                                    "replica_id": rid,
+                                    "ongoing": dep["stats"].get(rid, {}).get(
+                                        "ongoing", 0
+                                    ),
+                                    "handle_info": rec["handle_info"],
+                                }
+                                for rid, rec in dep["replicas"].items()
+                                if rec["healthy"]
+                            ],
+                        }
+                        for name, dep in self._deployments.items()
+                        if not dep["deleting"]
+                    }
+                    return {"version": self._version, "table": table}
+            if time.monotonic() >= deadline:
+                return {"version": known_version, "table": None}
+            time.sleep(0.05)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target": dep["target_replicas"],
+                    "running": sum(
+                        1 for r in dep["replicas"].values() if r["healthy"]
+                    ),
+                    "route_prefix": dep["route_prefix"],
+                    "autoscaling": dep["autoscaling"],
+                }
+                for name, dep in self._deployments.items()
+            }
+
+    def ready(self, name: str, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                dep = self._deployments.get(name)
+                if dep is not None:
+                    healthy = sum(
+                        1 for r in dep["replicas"].values() if r["healthy"]
+                    )
+                    if healthy >= max(1, dep["target_replicas"]):
+                        return True
+            time.sleep(0.05)
+        return False
+
+    def shutdown(self) -> bool:
+        self._stop.set()
+        with self._lock:
+            deps = list(self._deployments.values())
+            proxies = list(self._proxies.values())
+            self._deployments.clear()
+            self._proxies.clear()
+        for dep in deps:
+            for rec in dep["replicas"].values():
+                self._kill_silently(rec["handle"])
+        for p in proxies:
+            self._kill_silently(p)
+        return True
+
+    @staticmethod
+    def _kill_silently(handle) -> None:
+        try:
+            ray_tpu.kill(handle)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------------
+    # reconcile loop
+    # ------------------------------------------------------------------
+
+    def _reconcile_loop(self) -> None:
+        last_autoscale = 0.0
+        while not self._stop.wait(RECONCILE_PERIOD_S):
+            try:
+                self._check_health()
+                now = time.monotonic()
+                if now - last_autoscale >= AUTOSCALE_WINDOW_S:
+                    self._autoscale()
+                    last_autoscale = now
+                self._reconcile()
+                self._ensure_proxies()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                logger.exception("serve reconcile iteration failed")
+
+    def _check_health(self) -> None:
+        """Probe replicas; collect stats; drop dead ones from routing.
+
+        A probe timeout is NOT death: the stats call shares the replica's
+        request thread pool, so a saturated replica answers late. Death
+        (ActorDiedError and friends) removes immediately; timeouts only
+        remove after several consecutive misses, and the replica keeps
+        routing weight meanwhile (it is busy, which pow-2 already
+        penalizes via its last-known ongoing count)."""
+        from ray_tpu.core.exceptions import GetTimeoutError
+
+        with self._lock:
+            probes = [
+                (dep, rid, rec)
+                for dep in self._deployments.values()
+                for rid, rec in list(dep["replicas"].items())
+            ]
+        for dep, rid, rec in probes:
+            try:
+                stats = ray_tpu.get(rec["handle"].stats.remote(), timeout=5.0)
+                with self._lock:
+                    dep["stats"][rid] = stats
+                    rec["probe_misses"] = 0
+                    if not rec["healthy"]:
+                        rec["healthy"] = True
+                        self._version += 1
+                continue
+            except GetTimeoutError:
+                with self._lock:
+                    rec["probe_misses"] = rec.get("probe_misses", 0) + 1
+                    dead = rec["probe_misses"] >= 6  # ~30s unresponsive
+                if not dead:
+                    continue
+            except Exception:  # noqa: BLE001 — replica dead
+                pass
+            with self._lock:
+                if rec["healthy"]:
+                    rec["healthy"] = False
+                self._version += 1
+                dep["replicas"].pop(rid, None)
+                dep["stats"].pop(rid, None)
+            self._kill_silently(rec["handle"])
+            logger.warning(
+                "replica %s of %s failed health check; removed",
+                rid, dep["name"],
+            )
+
+    def _autoscale(self) -> None:
+        """requests-per-replica policy (reference autoscaling_policy.py):
+        desired = ceil(total_ongoing / target_ongoing_requests)."""
+        with self._lock:
+            deps = list(self._deployments.values())
+        for dep in deps:
+            auto = dep["autoscaling"]
+            if not auto or dep["deleting"]:
+                continue
+            with self._lock:
+                total_ongoing = sum(
+                    s.get("ongoing", 0) for s in dep["stats"].values()
+                )
+                target_per = max(1e-9, float(auto.get("target_ongoing_requests", 1)))
+                desired = math.ceil(total_ongoing / target_per)
+                desired = max(int(auto.get("min_replicas", 1)), desired)
+                desired = min(int(auto.get("max_replicas", 8)), desired)
+                if desired != dep["target_replicas"]:
+                    logger.info(
+                        "autoscaling %s: %d -> %d (ongoing=%d)",
+                        dep["name"], dep["target_replicas"], desired,
+                        total_ongoing,
+                    )
+                    dep["target_replicas"] = desired
+
+    def _reconcile(self) -> None:
+        """Start/stop replicas to match target."""
+        with self._lock:
+            deps = list(self._deployments.values())
+        for dep in deps:
+            with self._lock:
+                current = len(dep["replicas"])
+                target = dep["target_replicas"]
+                deleting = dep["deleting"]
+            for _ in range(current, target):
+                self._start_replica(dep)
+            if current > target:
+                with self._lock:
+                    victims = list(dep["replicas"].items())[target - current:]
+                    for rid, rec in victims:
+                        dep["replicas"].pop(rid, None)
+                        dep["stats"].pop(rid, None)
+                    self._version += 1
+                for _, rec in victims:
+                    self._kill_silently(rec["handle"])
+            if deleting:
+                with self._lock:
+                    empty = not dep["replicas"]
+                    name = dep["name"]
+                if empty:
+                    with self._lock:
+                        self._deployments.pop(name, None)
+                        self._version += 1
+
+    def _start_replica(self, dep: Dict[str, Any]) -> None:
+        with self._lock:
+            rid = f"{dep['name']}#{dep['next_replica']}"
+            dep["next_replica"] += 1
+        res = dict(dep["resources"])
+        handle = ServeReplica.options(
+            name=f"SERVE_REPLICA::{rid}",
+            max_concurrency=dep["max_concurrency"],
+            num_cpus=res.pop("CPU", 1),
+            num_tpus=res.pop("TPU", 0) or None,
+            resources=res or None,
+        ).remote(
+            dep["name"], dep["callable_blob"], dep["init_args"],
+            dep["init_kwargs"],
+        )
+        with self._lock:
+            dep["replicas"][rid] = {
+                "handle": handle,
+                # (actor_id, class_name, method_meta): routers rebuild a
+                # borrower ActorHandle from this (handles are plain
+                # pickleable records, actor.py __reduce__)
+                "handle_info": (
+                    handle._actor_id, handle._class_name, handle._method_meta
+                ),
+                "healthy": True,
+            }
+            self._version += 1
+        logger.info("started replica %s", rid)
+
+    # ------------------------------------------------------------------
+    # proxies (one per node, reference proxy.py:1176)
+    # ------------------------------------------------------------------
+
+    def _ensure_proxies(self) -> None:
+        if self._http_port is None:
+            return
+        from ray_tpu.core.api import NodeAffinitySchedulingStrategy, nodes
+        from ray_tpu.serve.proxy import ServeProxy
+
+        alive = {n["node_id"]: n for n in nodes() if n.get("alive", True)}
+        with self._lock:
+            missing = [nid for nid in alive if nid not in self._proxies]
+            gone = [nid for nid in self._proxies if nid not in alive]
+            for nid in gone:
+                self._proxies.pop(nid, None)
+        for nid in missing:
+            proxy = ServeProxy.options(
+                name=f"SERVE_PROXY::{nid[:8]}",
+                scheduling_strategy=NodeAffinitySchedulingStrategy(nid),
+                num_cpus=0,
+            ).remote(self._http_port)
+            with self._lock:
+                self._proxies[nid] = proxy
+
+    def proxy_addresses(self) -> List[str]:
+        with self._lock:
+            proxies = list(self._proxies.values())
+        addrs = []
+        for p in proxies:
+            try:
+                addrs.append(ray_tpu.get(p.address.remote(), timeout=10))
+            except Exception:  # noqa: BLE001
+                pass
+        return addrs

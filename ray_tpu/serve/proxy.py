@@ -1,0 +1,103 @@
+"""Per-node HTTP proxy actor.
+
+Parity: the reference ProxyActor/HTTPProxy (python/ray/serve/_private/
+proxy.py:1176,827): one proxy per node accepts HTTP, matches the route
+prefix, routes to a replica (pow-2 router) and returns the response.
+Implemented on the stdlib ThreadingHTTPServer — request handling threads
+block on the replica call, the actor's own RPC threads stay free.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+import ray_tpu
+from ray_tpu.serve.replica import Request
+
+
+@ray_tpu.remote
+class ServeProxy:
+    def __init__(self, port: int = 0, controller_name: str = "SERVE_CONTROLLER"):
+        from ray_tpu.serve.router import Router
+
+        controller = ray_tpu.get_actor(controller_name)
+        self._router = Router(controller)
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _handle(self, method: str):
+                parsed = urlparse(self.path)
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    status, payload = proxy._dispatch(
+                        method, parsed.path, dict(parse_qsl(parsed.query)),
+                        dict(self.headers), body,
+                    )
+                except TimeoutError as e:
+                    status, payload = 503, json.dumps(
+                        {"error": str(e)}
+                    ).encode()
+                except Exception as e:  # noqa: BLE001 — app errors -> 500
+                    status, payload = 500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    ).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serve-http", daemon=True
+        )
+        self._thread.start()
+
+    def _dispatch(self, method: str, path: str, query, headers, body: bytes):
+        if path == "/-/routes":
+            self._router._refresh(force=True)
+            return 200, json.dumps(
+                {
+                    name: dep["route_prefix"]
+                    for name, dep in self._router._table.items()
+                }
+            ).encode()
+        if path == "/-/healthz":
+            return 200, b'"ok"'
+        deployment = self._router.deployment_for_route(path)
+        if deployment is None:
+            return 404, json.dumps({"error": f"no route for {path}"}).encode()
+        request = Request(method, path, body, headers, query)
+        result = self._router.call(deployment, request, timeout_s=120)
+        if isinstance(result, bytes):
+            return 200, result
+        return 200, json.dumps(result).encode()
+
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"127.0.0.1:{port}"
+
+    def health(self) -> bool:
+        return True

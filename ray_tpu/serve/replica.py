@@ -1,0 +1,83 @@
+"""Serve replica actor.
+
+Parity: the reference Replica/UserCallableWrapper
+(python/ray/serve/_private/replica.py:1688,2679): hosts one instance of
+the user's deployment callable, tracks ongoing-request count (the signal
+the pow-2 router and the autoscaler consume), and exposes a health probe.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import ray_tpu
+
+
+class Request:
+    """Minimal HTTP-ish request object handed to deployments called via
+    the proxy (parity: starlette Request in the reference)."""
+
+    def __init__(self, method: str, path: str, body: bytes,
+                 headers: Optional[Dict[str, str]] = None,
+                 query: Optional[Dict[str, str]] = None):
+        self.method = method
+        self.path = path
+        self.body = body
+        self.headers = headers or {}
+        self.query = query or {}
+
+    def json(self) -> Any:
+        import json
+
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode("utf-8", errors="replace")
+
+
+@ray_tpu.remote
+class ServeReplica:
+    """One replica of a deployment. max_concurrency on the actor lets
+    multiple requests execute concurrently in threads; _ongoing tracks
+    in-flight requests for routing/autoscaling."""
+
+    def __init__(self, deployment_name: str, callable_blob: bytes,
+                 init_args: Tuple, init_kwargs: Dict[str, Any]):
+        from ray_tpu.utils import serialization
+
+        self.deployment_name = deployment_name
+        cls_or_fn = serialization.loads(callable_blob)
+        if isinstance(cls_or_fn, type):
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self._callable = cls_or_fn
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        self._started = time.time()
+
+    def handle_request(self, payload: Any, *, method: Optional[str] = None):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = self._callable
+            if method:
+                target = getattr(self._callable, method)
+            return target(payload)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def health(self) -> bool:
+        return True
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "ongoing": self._ongoing,
+                "total": self._total,
+                "uptime_s": time.time() - self._started,
+            }

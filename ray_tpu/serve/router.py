@@ -1,0 +1,138 @@
+"""Request router — power-of-two-choices replica selection.
+
+Parity: the reference Router + PowerOfTwoChoicesRequestRouter
+(python/ray/serve/_private/router.py:473, request_router/pow_2_router.py):
+sample two replicas, pick the one with the smaller known queue; queue
+lengths come from the controller's routing table, refreshed by version
+polling (long-poll-lite) plus a local in-flight delta so bursts spread
+before the next refresh.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.core.actor import ActorHandle
+
+ROUTE_REFRESH_S = 1.0
+
+
+class Router:
+    def __init__(self, controller: Any):
+        self._controller = controller
+        self._lock = threading.Lock()
+        self._version = -1
+        self._table: Dict[str, Dict[str, Any]] = {}
+        self._last_refresh = 0.0
+        # replica_id -> locally-issued in-flight count (delta on top of
+        # the controller-reported ongoing count)
+        self._local_inflight: Dict[str, int] = {}
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_refresh < ROUTE_REFRESH_S:
+                return
+            version = self._version
+        reply = ray_tpu.get(
+            self._controller.get_routing_table.remote(version, 0.0),
+            timeout=10,
+        )
+        with self._lock:
+            self._last_refresh = time.monotonic()
+            if reply["table"] is not None:
+                self._version = reply["version"]
+                self._table = reply["table"]
+                # fresh ongoing counts supersede the local deltas (callers
+                # that never report completion decay here)
+                self._local_inflight.clear()
+
+    def deployment_for_route(self, path: str) -> Optional[str]:
+        self._refresh()
+        with self._lock:
+            best = None
+            for name, dep in self._table.items():
+                prefix = dep["route_prefix"]
+                if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                    if best is None or len(prefix) > len(
+                        self._table[best]["route_prefix"]
+                    ):
+                        best = name
+            return best
+
+    def choose_replica(self, deployment: str, timeout_s: float = 30.0):
+        """Pow-2 choice; blocks (re-polling) until a replica exists."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            self._refresh()
+            with self._lock:
+                dep = self._table.get(deployment)
+                replicas = list(dep["replicas"]) if dep else []
+                if replicas:
+                    if len(replicas) == 1:
+                        chosen = replicas[0]
+                    else:
+                        a, b = random.sample(replicas, 2)
+                        chosen = min(
+                            (a, b),
+                            key=lambda r: r["ongoing"]
+                            + self._local_inflight.get(r["replica_id"], 0),
+                        )
+                    rid = chosen["replica_id"]
+                    self._local_inflight[rid] = (
+                        self._local_inflight.get(rid, 0) + 1
+                    )
+                    return rid, ActorHandle(*chosen["handle_info"])
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"no replicas available for deployment {deployment!r}"
+                )
+            self._refresh(force=True)
+            time.sleep(0.1)
+
+    def request_finished(self, replica_id: str) -> None:
+        with self._lock:
+            n = self._local_inflight.get(replica_id, 0) - 1
+            if n <= 0:
+                self._local_inflight.pop(replica_id, None)
+            else:
+                self._local_inflight[replica_id] = n
+
+    def assign(self, deployment: str, payload: Any,
+               method: Optional[str] = None, timeout_s: float = 30.0):
+        """Route one request; returns (replica_id, result ObjectRef)."""
+        rid, handle = self.choose_replica(deployment, timeout_s)
+        if method:
+            return rid, handle.handle_request.remote(payload, method=method)
+        return rid, handle.handle_request.remote(payload)
+
+    def call(self, deployment: str, payload: Any,
+             method: Optional[str] = None, timeout_s: float = 60.0) -> Any:
+        """Route + get with retry on replica death: the routing table lags
+        replica failures by up to a health-check period, so a request that
+        lands on a corpse is transparently re-routed (reference: the
+        router's queue-probe failures trigger re-selection)."""
+        from ray_tpu.core.exceptions import (
+            ActorDiedError,
+            ActorUnavailableError,
+        )
+
+        deadline = time.monotonic() + timeout_s
+        last_exc: Optional[BaseException] = None
+        for _ in range(4):
+            remaining = max(0.5, deadline - time.monotonic())
+            rid, ref = self.assign(deployment, payload, method, remaining)
+            try:
+                return ray_tpu.get(ref, timeout=remaining)
+            except (ActorDiedError, ActorUnavailableError) as e:
+                last_exc = e
+                self._refresh(force=True)
+            finally:
+                self.request_finished(rid)
+            if time.monotonic() >= deadline:
+                break
+        raise last_exc
