@@ -67,10 +67,14 @@ def main() -> None:
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
+        # Tuned on v5e (see PROFILE.md): fully-unrolled 12-layer scan, no
+        # remat (fits at B=32), fused custom-vjp CE head, 1024x1024 flash
+        # tiles. 399ms/step -> 308ms/step (MFU 0.31 -> 0.40).
         cfg = dataclasses.replace(
-            gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=True
+            gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=False,
+            scan_unroll=12, loss_impl="fused", loss_chunk=256,
         )
-        batch, seq, steps = 32, 1024, 10
+        batch, seq, steps = 32, 1024, 20
     else:  # CI smoke mode
         cfg = gpt2.CONFIGS["gpt2-tiny"]
         batch, seq, steps = 8, 64, 3
@@ -86,17 +90,31 @@ def main() -> None:
             total_rows=batch * (steps + 1), batch=batch, seq=seq,
             vocab=cfg.vocab_size, parallelism=steps + 1,
         )
+        # Device double-buffering: batch t+1 transfers host->device while
+        # step t runs (the device half of the input pipeline; the data
+        # iterator's prefetch thread is the host half).
+        def device_batches(it):
+            pending = None
+            for b in it:
+                nxt = jax.device_put(b["tokens"])
+                if pending is not None:
+                    yield pending
+                pending = nxt
+            if pending is not None:
+                yield pending
+
+        batches = device_batches(batches)
         # warmup / compile on the first pipeline batch (float() forces a
         # device sync — block_until_ready alone does not drain the axon
         # remote-execution tunnel)
-        first = next(batches)["tokens"]
+        first = next(batches)
         params, opt_state, loss = step(params, opt_state, first)
         float(loss)
 
         t0 = time.perf_counter()
         n_steps = 0
         for b in batches:
-            params, opt_state, loss = step(params, opt_state, b["tokens"])
+            params, opt_state, loss = step(params, opt_state, b)
             n_steps += 1
         float(loss)
         dt = time.perf_counter() - t0

@@ -250,6 +250,8 @@ class _ActorRuntime:
         self.max_concurrency = max_concurrency
         self.queue: "queue.Queue" = queue.Queue()
         self.threads: List[threading.Thread] = []
+        self.running = 0  # executions in flight (guarded by running_lock)
+        self.running_lock = threading.Lock()
 
 
 class CoreWorker:
@@ -924,16 +926,19 @@ class CoreWorker:
         return sender
 
     def _resolve_actor_address(self, actor_id: str, timeout_s: float = 60.0) -> str:
-        """Block until the actor is ALIVE (pending creation / restart /
-        resource queuing can legitimately take long — reference callers
-        block on the GCS actor table the same way)."""
+        """Block until the actor is ALIVE, up to timeout_s total (pending
+        creation / restart / resource queuing can legitimately take long —
+        reference callers block on the GCS actor table the same way, but
+        the timeout bounds the WHOLE wait, not each control-store call)."""
         addr = self._actor_addr_cache.get(actor_id)
         if addr:
             return addr
+        deadline = time.monotonic() + timeout_s
         while True:
+            remaining = max(0.05, deadline - time.monotonic())
             info = self.control.call(
-                "wait_actor_alive", actor_id=actor_id, wait_s=timeout_s,
-                timeout_s=timeout_s + 30.0, retryable=True,
+                "wait_actor_alive", actor_id=actor_id, wait_s=remaining,
+                timeout_s=remaining + 30.0, retryable=True,
             )
             if info is None:
                 raise ActorDiedError(f"actor {actor_id} does not exist")
@@ -944,7 +949,7 @@ class CoreWorker:
             if info["state"] == "ALIVE" and info.get("worker_address"):
                 self._actor_addr_cache[actor_id] = info["worker_address"]
                 return info["worker_address"]
-            if self._shutdown.is_set():
+            if self._shutdown.is_set() or time.monotonic() >= deadline:
                 raise ActorUnavailableError(f"actor {actor_id} is {info['state']}")
             time.sleep(0.05)
 
@@ -1046,8 +1051,26 @@ class CoreWorker:
                 conn, req_id, spec = rt.queue.get(timeout=0.5)
             except queue.Empty:
                 continue
-            reply = self._execute_spec(spec)
+            with rt.running_lock:
+                rt.running += 1
+            try:
+                reply = self._execute_spec(spec)
+            finally:
+                with rt.running_lock:
+                    rt.running -= 1
             RpcServer.reply(conn, req_id, True, reply)
+
+    def rpc_actor_queue_stats(self, conn):
+        """Queue depth + in-flight count for the hosted actor, served by
+        the RPC layer (NOT the actor's execution queue) so probes answer
+        instantly even when every actor thread is busy — the reference
+        replica's out-of-band queue-length probe."""
+        rt = self._actor_runtime
+        if rt is None:
+            return None
+        with rt.running_lock:
+            running = rt.running
+        return {"queued": rt.queue.qsize(), "running": running}
 
     def rpc_create_actor(self, conn, spec: Dict[str, Any]):
         """Returns {"ok": True} or {"ok": False, "error": TaskError}.
@@ -1271,7 +1294,11 @@ class _ActorSender:
             addr = None
             for _ in range(3):
                 try:
-                    addr = w._resolve_actor_address(spec.actor_id)
+                    # Long bound: calls to an actor still pending creation /
+                    # restart legitimately wait (reference blocks on the GCS
+                    # actor table); probes that need a short bound pass
+                    # their own timeout_s.
+                    addr = w._resolve_actor_address(spec.actor_id, timeout_s=3600.0)
                     client = w.workers.get(addr)
                     pending = client.call_async("actor_task", spec=spec)
                     self.inflight.put((pending, spec))

@@ -216,12 +216,14 @@ import os
 
 
 def _block_sizes(T: int):
-    """(bq, bk) for sequence length T. K blocks stay large — the online-
-    softmax bookkeeping amortizes over bk, and a [bq, bk] f32 score tile
-    up to 512x2048 is only 4MB of VMEM — while still bounding VMEM for
-    long sequences (T=128k works at the same tile size)."""
-    tq = int(os.environ.get("RT_FLASH_BQ", "256"))
-    tk = int(os.environ.get("RT_FLASH_BK", "2048"))
+    """(bq, bk) for sequence length T. 1024x1024 measured fastest on v5e
+    for the train step (PROFILE.md): the [bq, bk] f32 score tile is 4MB of
+    VMEM, large q tiles amortize the [bq, D]-contraction's half-width MXU
+    occupancy (D=64), and at T<=1024 the kernel runs the one-shot
+    softmax path (single K block, no online-softmax carries). VMEM stays
+    bounded for long sequences (T=128k runs at the same tile size)."""
+    tq = int(os.environ.get("RT_FLASH_BQ", "1024"))
+    tk = int(os.environ.get("RT_FLASH_BK", "1024"))
     return _pick_block(T, tq), _pick_block(T, tk)
 
 

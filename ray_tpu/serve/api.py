@@ -153,9 +153,16 @@ class DeploymentResponse:
             ActorUnavailableError,
         )
 
+        from ray_tpu.core.exceptions import GetTimeoutError
+
         if not self._done:
             try:
                 self._value = ray_tpu.get(self._ref, timeout=timeout_s)
+            except GetTimeoutError:
+                # the request is still running: NOT a terminal outcome —
+                # the response stays live (in-flight count included) and
+                # the caller may retry result() with a longer timeout
+                raise
             except (ActorDiedError, ActorUnavailableError):
                 # replica died under us: re-route the request
                 try:
@@ -167,9 +174,8 @@ class DeploymentResponse:
                     self._error = e
             except BaseException as e:  # noqa: BLE001
                 self._error = e
-            finally:
-                self._done = True
-                self._router.request_finished(self._replica_id)
+            self._done = True
+            self._router.request_finished(self._replica_id)
         if self._error is not None:
             raise self._error
         return self._value

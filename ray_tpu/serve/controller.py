@@ -100,12 +100,15 @@ class ServeController:
         return True
 
     def get_routing_table(self, known_version: int = -1, wait_s: float = 0.0):
-        """Routing table + version. If known_version is current, optionally
-        wait up to wait_s for a change (long-poll-lite)."""
+        """Routing table + version. With wait_s > 0, blocks until the
+        TOPOLOGY version changes (long-poll-lite). With wait_s == 0 the
+        current table is always returned — replica `ongoing` counts change
+        continuously without bumping the version, and routers need them
+        fresh (pow-2 would otherwise route on frozen queue lengths)."""
         deadline = time.monotonic() + wait_s
         while True:
             with self._lock:
-                if self._version != known_version:
+                if self._version != known_version or wait_s <= 0:
                     table = {
                         name: {
                             "route_prefix": dep["route_prefix"],
@@ -197,16 +200,19 @@ class ServeController:
                 logger.exception("serve reconcile iteration failed")
 
     def _check_health(self) -> None:
-        """Probe replicas; collect stats; drop dead ones from routing.
+        """Probe replicas; collect queue stats; drop dead ones.
 
-        A probe timeout is NOT death: the stats call shares the replica's
-        request thread pool, so a saturated replica answers late. Death
-        (ActorDiedError and friends) removes immediately; timeouts only
-        remove after several consecutive misses, and the replica keeps
-        routing weight meanwhile (it is busy, which pow-2 already
-        penalizes via its last-known ongoing count)."""
-        from ray_tpu.core.exceptions import GetTimeoutError
+        Probes hit the hosting worker's RPC layer (rpc_actor_queue_stats),
+        NOT the replica's execution queue, so a saturated replica still
+        answers instantly and `ongoing` counts queued + running requests —
+        the reference replica's out-of-band queue-length probe. Transient
+        RPC timeouts tolerate several misses; a dead worker (connection
+        refused / actor lookup failure) removes the replica immediately."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.core.exceptions import ActorDiedError
+        from ray_tpu.utils.rpc import RpcConnectionError, RpcError
 
+        w = worker_mod.global_worker()
         with self._lock:
             probes = [
                 (dep, rid, rec)
@@ -214,23 +220,40 @@ class ServeController:
                 for rid, rec in list(dep["replicas"].items())
             ]
         for dep, rid, rec in probes:
+            dead = False
             try:
-                stats = ray_tpu.get(rec["handle"].stats.remote(), timeout=5.0)
+                addr = w._resolve_actor_address(
+                    rec["handle"]._actor_id, timeout_s=5.0
+                )
+                stats = w.workers.get(addr).call(
+                    "actor_queue_stats", timeout_s=5.0
+                )
+                if stats is None:
+                    raise RpcConnectionError("worker hosts no actor")
                 with self._lock:
-                    dep["stats"][rid] = stats
+                    dep["stats"][rid] = {
+                        "ongoing": stats["queued"] + stats["running"],
+                    }
                     rec["probe_misses"] = 0
                     if not rec["healthy"]:
                         rec["healthy"] = True
                         self._version += 1
                 continue
-            except GetTimeoutError:
+            except ActorDiedError:
+                dead = True  # control plane confirms death: remove now
+            except RpcConnectionError:
+                # connection loss is ambiguous (worker rebinding, network
+                # blip, or real death) — weigh it heavier than a timeout
+                # but do not kill a healthy replica on one strike
+                with self._lock:
+                    rec["probe_misses"] = rec.get("probe_misses", 0) + 3
+                    dead = rec["probe_misses"] >= 6
+            except (RpcError, Exception):  # noqa: BLE001 — slow or dying
                 with self._lock:
                     rec["probe_misses"] = rec.get("probe_misses", 0) + 1
                     dead = rec["probe_misses"] >= 6  # ~30s unresponsive
-                if not dead:
-                    continue
-            except Exception:  # noqa: BLE001 — replica dead
-                pass
+            if not dead:
+                continue
             with self._lock:
                 if rec["healthy"]:
                     rec["healthy"] = False
@@ -313,17 +336,28 @@ class ServeController:
             dep["init_kwargs"],
         )
         with self._lock:
-            dep["replicas"][rid] = {
-                "handle": handle,
-                # (actor_id, class_name, method_meta): routers rebuild a
-                # borrower ActorHandle from this (handles are plain
-                # pickleable records, actor.py __reduce__)
-                "handle_info": (
-                    handle._actor_id, handle._class_name, handle._method_meta
-                ),
-                "healthy": True,
-            }
-            self._version += 1
+            # A redeploy may have replaced the record while this replica
+            # was starting: registering into the orphaned dict would leak
+            # a live actor nothing tracks.
+            if self._deployments.get(dep["name"]) is not dep:
+                stale = True
+            else:
+                stale = False
+                dep["replicas"][rid] = {
+                    "handle": handle,
+                    # (actor_id, class_name, method_meta): routers rebuild
+                    # a borrower ActorHandle from this (handles are plain
+                    # pickleable records, actor.py __reduce__)
+                    "handle_info": (
+                        handle._actor_id, handle._class_name,
+                        handle._method_meta,
+                    ),
+                    "healthy": True,
+                }
+                self._version += 1
+        if stale:
+            self._kill_silently(handle)
+            return
         logger.info("started replica %s", rid)
 
     # ------------------------------------------------------------------

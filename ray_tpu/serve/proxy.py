@@ -96,8 +96,13 @@ class ServeProxy:
         return 200, json.dumps(result).encode()
 
     def address(self) -> str:
-        host, port = self._server.server_address[:2]
-        return f"127.0.0.1:{port}"
+        from ray_tpu.core import worker as worker_mod
+
+        port = self._server.server_address[1]
+        # the node's routable address, not loopback: multi-node clients
+        # must be able to reach every node's proxy
+        host = worker_mod.global_worker().node_agent_address.split(":")[0]
+        return f"{host}:{port}"
 
     def health(self) -> bool:
         return True

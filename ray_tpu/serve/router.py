@@ -47,8 +47,10 @@ class Router:
             if reply["table"] is not None:
                 self._version = reply["version"]
                 self._table = reply["table"]
-                # fresh ongoing counts supersede the local deltas (callers
-                # that never report completion decay here)
+                # fresh controller-observed ongoing counts supersede the
+                # local deltas (callers that never report completion decay
+                # here) — wait_s=0 polls always return a table, so this
+                # runs every ROUTE_REFRESH_S
                 self._local_inflight.clear()
 
     def deployment_for_route(self, path: str) -> Optional[str]:

@@ -131,3 +131,53 @@ def test_mlp(cpu_mesh_devices):
     y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 4)
     assert mlp.forward(params, x).shape == (8, 4)
     assert float(mlp.loss_fn(params, (x, y))) > 0
+
+
+def test_fused_ce_matches_reference():
+    """loss_impl="fused" (custom-vjp CE head, PROFILE.md) must match the
+    unchunked reference loss and gradients."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.CONFIGS["gpt2-tiny"]
+    cfg_fused = dataclasses.replace(cfg, loss_impl="fused", loss_chunk=16)
+    cfg_ref = dataclasses.replace(cfg, loss_chunk=0)
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 65), 0, cfg.vocab_size, dtype="int32"
+    )
+    lf = float(gpt2.loss_fn(params, toks, cfg_fused))
+    lr = float(gpt2.loss_fn(params, toks, cfg_ref))
+    assert abs(lf - lr) < 1e-3
+    gf = jax.grad(gpt2.loss_fn)(params, toks, cfg_fused)
+    gr = jax.grad(gpt2.loss_fn)(params, toks, cfg_ref)
+    errs = jax.tree.map(
+        lambda a, b: float(np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-9)),
+        gf, gr,
+    )
+    assert max(jax.tree.leaves(errs)) < 0.05
+
+
+def test_scan_unroll_same_numerics():
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.CONFIGS["gpt2-tiny"]
+    cfg_u = dataclasses.replace(cfg, scan_unroll=2)
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab_size, dtype="int32"
+    )
+    # unrolling changes XLA fusion order, so bf16 logits differ in the
+    # low bits; the loss must agree to bf16-roundoff tolerance
+    lr = float(gpt2.loss_fn(params, toks, cfg))
+    lu = float(gpt2.loss_fn(params, toks, cfg_u))
+    np.testing.assert_allclose(lu, lr, rtol=2e-3)

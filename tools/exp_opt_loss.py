@@ -1,0 +1,84 @@
+"""Isolate optimizer cost + sweep loss_chunk + remat variants (on chip)."""
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import gpt2
+
+PEAK = 197e12
+B, T = 32, 1024
+
+
+def sync(x):
+    float(jnp.asarray(jax.tree.leaves(x)[0]).ravel()[0])
+
+
+def timeit(fn, *args, steps=10):
+    out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / steps
+
+
+cfg0 = dataclasses.replace(gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=True)
+params = gpt2.init(jax.random.PRNGKey(0), cfg0)
+tokens = jax.random.randint(
+    jax.random.PRNGKey(1), (B, T + 1), 0, cfg0.vocab_size, dtype="int32"
+)
+n_params = sum(x.size for x in jax.tree.leaves(params))
+
+# --- optimizer alone: update with fake grads (same pytree) ---
+opt = optax.adamw(3e-4, weight_decay=0.01)
+opt_state = opt.init(params)
+grads = jax.tree.map(lambda p: p * 1e-6, params)
+
+
+@jax.jit
+def opt_step(params, opt_state, grads):
+    updates, opt_state = opt.update(grads, opt_state, params)
+    params = jax.tree.map(lambda p, u: (p + u).astype(p.dtype), params, updates)
+    return params, opt_state
+
+
+t = timeit(opt_step, params, opt_state, grads)
+print(f"adamw update alone: {t*1000:.1f} ms "
+      f"(theoretical HBM ~{n_params*4*7/819e9*1000:.1f} ms)")
+
+# --- loss chunk sweep (full step) ---
+for chunk in (0, 128, 256, 512):
+    cfg = dataclasses.replace(cfg0, loss_chunk=chunk)
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    try:
+        p2, o2, loss = step(params, opt_state, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            p2, o2, loss = step(p2, o2, tokens)
+        float(loss)
+        dt = (time.perf_counter() - t0) / 10
+        print(f"loss_chunk={chunk:4d}: {dt*1000:6.1f} ms/step "
+              f"mfu={6*n_params*B*T/dt/PEAK:.4f}")
+    except Exception as e:
+        print(f"loss_chunk={chunk:4d}: FAILED {type(e).__name__}: {str(e)[:80]}")
+
+# --- remat: attn_out-only policy ---
+import jax.ad_checkpoint  # noqa: E402
+
+
+def attn_only_body(cfg):
+    return None
+
+
+for name, kwargs in (
+    ("remat policy=save attn_out", dict(remat_policy="attn_out")),
+):
+    pass
+
+# add an "attn_out" policy inline by monkeypatching gpt2.backbone choice:
+# instead, test scan unroll via cfg? Not exposed. Done here.

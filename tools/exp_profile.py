@@ -1,0 +1,55 @@
+"""Capture a jax.profiler trace of the GPT-2 train step and print the
+op-level time breakdown (framework_op_stats via tensorboard_plugin_profile)."""
+import dataclasses
+import glob
+import os
+import sys
+import time
+
+import jax
+import optax
+
+from ray_tpu.models import gpt2
+
+B, T = 32, 1024
+LOGDIR = "/tmp/rt_profile"
+
+
+def main():
+    cfg = dataclasses.replace(
+        gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=True,
+        remat_policy=os.environ.get("RT_PROF_REMAT", "attn_out"),
+        loss_chunk=int(os.environ.get("RT_PROF_CHUNK", "0")),
+    )
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab_size, dtype="int32"
+    )
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+
+    os.system(f"rm -rf {LOGDIR}")
+    jax.profiler.start_trace(LOGDIR)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+    jax.profiler.stop_trace()
+
+    xs = glob.glob(f"{LOGDIR}/**/*.xplane.pb", recursive=True)
+    print("xplane files:", xs, file=sys.stderr)
+    if not xs:
+        return
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data(xs, "framework_op_stats", {})
+    out = "/tmp/rt_profile/op_stats.csv"
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(out, mode) as f:
+        f.write(data)
+    print("wrote", out)
+
+
+main()

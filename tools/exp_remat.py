@@ -1,0 +1,50 @@
+"""Measure MFU across remat policies / attention impls on the real chip."""
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import gpt2
+
+PEAK = 197e12
+
+
+def run(name, cfg, batch=32, seq=1024, steps=10):
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size, dtype="int32"
+    )
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    try:
+        params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        dt = time.perf_counter() - t0
+    except Exception as e:
+        print(f"{name:40s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+        return
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tps = batch * seq * steps / dt
+    mfu = tps * 6 * n_params / PEAK
+    print(f"{name:40s} {tps:9.0f} tok/s  mfu={mfu:.4f}  ms/step={dt/steps*1000:.1f}")
+
+
+base = dataclasses.replace(gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=True)
+run("flash remat=full (bench today)", base)
+run("flash remat=dots_saveable",
+    dataclasses.replace(base, remat_policy="dots_saveable"))
+run("flash remat=dots",
+    dataclasses.replace(base, remat_policy="dots"))
+run("flash remat=OFF",
+    dataclasses.replace(base, remat=False))
+run("reference-attn remat=OFF",
+    dataclasses.replace(base, attn_impl="reference", remat=False))
+run("reference-attn remat=dots_saveable",
+    dataclasses.replace(base, attn_impl="reference", remat_policy="dots_saveable"))

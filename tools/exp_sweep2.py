@@ -1,0 +1,45 @@
+"""Sweep loss_chunk x remat policy (fresh state per config, on chip)."""
+import dataclasses
+import time
+
+import jax
+import optax
+
+from ray_tpu.models import gpt2
+
+PEAK = 197e12
+B, T = 32, 1024
+
+
+def run(name, cfg, steps=10):
+    params = gpt2.init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (B, T + 1), 0, cfg.vocab_size, dtype="int32"
+    )
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    try:
+        params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        dt = (time.perf_counter() - t0) / steps
+        print(f"{name:50s} {dt*1000:6.1f} ms  mfu={6*n_params*B*T/dt/PEAK:.4f}")
+    except Exception as e:
+        print(f"{name:50s} FAILED {type(e).__name__}: {str(e)[:90]}")
+
+
+base = dataclasses.replace(gpt2.CONFIGS["gpt2-small"], attn_impl="flash", remat=True)
+for chunk in (0, 256, 512):
+    run(f"remat=full loss_chunk={chunk}",
+        dataclasses.replace(base, loss_chunk=chunk))
+for pol in ("attn_out", "dots_saveable"):
+    run(f"remat={pol} loss_chunk=0",
+        dataclasses.replace(base, remat_policy=pol, loss_chunk=0))
+run("remat=OFF loss_chunk=0", dataclasses.replace(base, remat=False, loss_chunk=0))
+run("remat=OFF loss_chunk=256",
+    dataclasses.replace(base, remat=False, loss_chunk=256))
