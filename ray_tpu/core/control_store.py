@@ -573,6 +573,7 @@ class ControlStore:
             "method_names": r.get("method_names", []),
             "num_restarts": r.get("num_restarts", 0),
             "max_restarts": r.get("max_restarts", 0),
+            "max_task_retries": r.get("max_task_retries", 0),
             "death_cause": r.get("death_cause"),
             "job_id": r.get("job_id"),
             "lifetime": r.get("lifetime"),

@@ -625,6 +625,7 @@ class NodeAgent:
                     for pg, r in self._bundles.items()
                 },
                 "store_usage": self.store.usage(),
+                "spill_stats": self.store.spill_stats(),
             }
 
 

@@ -31,10 +31,44 @@ from ray_tpu.utils.ids import ObjectID
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
 
 
-class ShmObjectStore:
-    """Per-node store bookkeeping: create/seal/get-meta/delete segments."""
+class _Entry:
+    __slots__ = ("path", "size", "sealed", "spill_path", "last_access", "state")
 
-    def __init__(self, session_id: str, node_id_hex: str, capacity_bytes: int):
+    def __init__(self, path: str, size: int):
+        self.path = path
+        self.size = size
+        self.sealed = False
+        self.spill_path: Optional[str] = None  # set once spilled to disk
+        self.last_access = time.monotonic()
+        # shm | spilling | spilled | restoring — byte copies for spill and
+        # restore run OUTSIDE the store lock (a GB-scale copy must not
+        # stall create/seal/read for its duration); transitions are
+        # finalized under the lock and announced on the store condition.
+        self.state = "shm"
+
+    @property
+    def in_shm(self) -> bool:
+        return self.state == "shm"
+
+
+class ShmObjectStore:
+    """Per-node store bookkeeping: create/seal/get-meta/delete segments,
+    with LRU spill-to-disk under memory pressure.
+
+    Spilling (parity: reference LocalObjectManager::SpillObjects,
+    src/ray/raylet/local_object_manager.h:144 + plasma eviction_policy.cc):
+    when a create would exceed capacity, least-recently-accessed sealed
+    segments move to spill files on disk and their shm space is freed.
+    Same-host readers transparently restore a spilled object into shm on
+    get_meta; cross-node chunk reads are served STRAIGHT from the spill
+    file (no restore — the bytes leave the node either way). Objects are
+    therefore never silently lost to pressure: disk, not shm, is the
+    capacity bound, and MemoryError remains only for objects larger than
+    the whole store with nothing left to spill.
+    """
+
+    def __init__(self, session_id: str, node_id_hex: str, capacity_bytes: int,
+                 spill_dir: Optional[str] = None):
         self._prefix = os.path.join(
             _SHM_DIR, f"rtshm_{session_id[:8]}_{node_id_hex[:8]}"
         )
@@ -42,28 +76,164 @@ class ShmObjectStore:
         # comparison works even when the shm dir itself is a symlink.
         self._real_dir = os.path.realpath(_SHM_DIR)
         self._base_prefix = os.path.basename(self._prefix)
+        self._spill_dir = spill_dir or os.path.join(
+            "/tmp", f"rtspill_{session_id[:8]}_{node_id_hex[:8]}"
+        )
         self._capacity = capacity_bytes
         self._used = 0
+        self._spilled_bytes = 0
         self._lock = threading.Lock()
         self._sealed_cv = threading.Condition(self._lock)
-        # oid hex -> (path, size, sealed)
-        self._objects: Dict[str, Tuple[str, int, bool]] = {}
+        self._objects: Dict[str, _Entry] = {}
+
+    # -- spill machinery -------------------------------------------------
+
+    def _spill_victims_locked(self, need: int):
+        """Oldest sealed in-shm segments totalling >= need bytes."""
+        victims = []
+        freed = 0
+        for oid, e in sorted(
+            self._objects.items(), key=lambda kv: kv[1].last_access
+        ):
+            if freed >= need:
+                break
+            if e.sealed and e.state == "shm":
+                victims.append((oid, e))
+                freed += e.size
+        return victims if freed >= need else None
+
+    def _copy(self, src_path: str, dst_fd: int) -> None:
+        with open(src_path, "rb") as src:
+            off = 0
+            while True:
+                buf = src.read(16 * 1024 * 1024)
+                if not buf:
+                    break
+                os.pwrite(dst_fd, buf, off)
+                off += len(buf)
+
+    def _spill_outside_lock(self, oid_hex: str, e: _Entry) -> None:
+        """Copy a segment (state already 'spilling') to disk; finalize
+        under the lock. Readers may keep using the shm path until the
+        unlink lands — data is immutable."""
+        os.makedirs(self._spill_dir, exist_ok=True)
+        spill_path = os.path.join(self._spill_dir, oid_hex)
+        fd = os.open(spill_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+        try:
+            self._copy(e.path, fd)
+        finally:
+            os.close(fd)
+        try:
+            os.unlink(e.path)
+        except OSError:
+            pass
+        with self._lock:
+            e.spill_path = spill_path
+            e.state = "spilled"
+            self._used -= e.size
+            self._spilled_bytes += e.size
+            self._sealed_cv.notify_all()
+
+    def _ensure_room_locked(self, size: int) -> None:
+        """Make room for `size` bytes, spilling LRU victims. Called with
+        the lock held; TEMPORARILY RELEASES it for the byte copies."""
+        while True:
+            # account bytes still being spilled by other threads as free-soon
+            if self._used + size <= self._capacity:
+                return
+            need = self._used + size - self._capacity
+            victims = self._spill_victims_locked(need)
+            if victims is None:
+                if any(e.state == "spilling" for e in self._objects.values()):
+                    self._sealed_cv.wait(1.0)  # someone else is freeing room
+                    continue
+                raise MemoryError(
+                    f"object store over capacity and nothing left to spill: "
+                    f"used={self._used} request={size} cap={self._capacity}"
+                )
+            for _, e in victims:
+                e.state = "spilling"
+            self._lock.release()
+            try:
+                for oid, e in victims:
+                    self._spill_outside_lock(oid, e)
+            finally:
+                self._lock.acquire()
+
+    def _restore_locked(self, oid_hex: str, e: _Entry) -> None:
+        """Bring a spilled segment back into shm (for same-host mmap).
+        Called with the lock held; releases it for the byte copy."""
+        while e.state in ("spilling", "restoring"):
+            self._sealed_cv.wait(1.0)  # another thread is moving it
+        if e.state == "shm":
+            return
+        self._ensure_room_locked(e.size)
+        e.state = "restoring"
+        self._used += e.size  # reserve before dropping the lock
+        self._lock.release()
+        try:
+            fd = os.open(e.path, os.O_CREAT | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, max(e.size, 1))
+                self._copy(e.spill_path, fd)
+            finally:
+                os.close(fd)
+            try:
+                os.unlink(e.spill_path)
+            except OSError:
+                pass
+        except BaseException:
+            with self._lock:
+                self._used -= e.size
+                e.state = "spilled"
+                self._sealed_cv.notify_all()
+            raise
+        finally:
+            self._lock.acquire()
+        e.spill_path = None
+        e.state = "shm"
+        self._spilled_bytes -= e.size
+        self._sealed_cv.notify_all()
+
+    # -- public API ------------------------------------------------------
 
     def create(self, oid_hex: str, size: int) -> str:
         # Full hex: ObjectIDs share a long job/task prefix, so any
         # truncation collides across a job's objects.
         path = f"{self._prefix}_{oid_hex}"
+        drop_paths = []
         with self._lock:
-            if oid_hex in self._objects:
-                raise ValueError(f"object {oid_hex} already exists")
-            if self._used + size > self._capacity:
-                raise MemoryError(
-                    f"object store over capacity: used={self._used} "
-                    f"request={size} cap={self._capacity}"
-                )
+            existing = self._objects.get(oid_hex)
+            if existing is not None:
+                if not existing.sealed:
+                    raise ValueError(f"object {oid_hex} is being created")
+                # Sealed re-create only happens when lineage reconstruction
+                # re-executes a producer whose (identical, immutable) value
+                # still exists after a transient failure: replace it.
+                self._objects.pop(oid_hex)
+                if existing.state == "shm":
+                    self._used -= existing.size
+                    drop_paths.append(existing.path)
+                elif existing.state == "spilled":
+                    self._spilled_bytes -= existing.size
+                    drop_paths.append(existing.spill_path)
+            # Insert first (unsealed entries are never spill victims), then
+            # make room — _ensure_room_locked may release the lock while
+            # spilling, and the reservation prevents duplicate creates.
+            self._objects[oid_hex] = _Entry(path, size)
             self._used += size
-            self._objects[oid_hex] = (path, size, False)
-        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                self._ensure_room_locked(0)
+            except MemoryError:
+                self._objects.pop(oid_hex, None)
+                self._used -= size
+                raise
+        for p in drop_paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, max(size, 1))
         finally:
@@ -75,19 +245,23 @@ class ShmObjectStore:
             entry = self._objects.get(oid_hex)
             if entry is None:
                 raise KeyError(oid_hex)
-            self._objects[oid_hex] = (entry[0], entry[1], True)
+            entry.sealed = True
             self._sealed_cv.notify_all()
 
     def get_meta(
         self, oid_hex: str, timeout_s: Optional[float] = None
     ) -> Optional[Tuple[str, int]]:
-        """Block until sealed (or timeout); return (path, size) or None."""
+        """Block until sealed (or timeout); return (path, size) or None.
+        Restores a spilled segment into shm (same-host readers mmap)."""
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         with self._lock:
             while True:
                 entry = self._objects.get(oid_hex)
-                if entry is not None and entry[2]:
-                    return entry[0], entry[1]
+                if entry is not None and entry.sealed:
+                    entry.last_access = time.monotonic()
+                    if not entry.in_shm:
+                        self._restore_locked(oid_hex, entry)
+                    return entry.path, entry.size
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -99,18 +273,29 @@ class ShmObjectStore:
     def contains(self, oid_hex: str) -> bool:
         with self._lock:
             entry = self._objects.get(oid_hex)
-            return entry is not None and entry[2]
+            return entry is not None and entry.sealed
 
     def delete(self, oid_hex: str) -> None:
         with self._lock:
+            entry = self._objects.get(oid_hex)
+            # a segment mid-spill/restore finishes its move first (the
+            # mover assumes the entry survives until its finalize)
+            while entry is not None and entry.state in ("spilling", "restoring"):
+                self._sealed_cv.wait(1.0)
+                entry = self._objects.get(oid_hex)
             entry = self._objects.pop(oid_hex, None)
             if entry is None:
                 return
-            self._used -= entry[1]
-        try:
-            os.unlink(entry[0])
-        except OSError:
-            pass
+            if entry.in_shm:
+                self._used -= entry.size
+            else:
+                self._spilled_bytes -= entry.size
+        for p in (entry.path if entry.in_shm else None, entry.spill_path):
+            if p:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
     def read_chunk(self, path: str, offset: int, length: int) -> Optional[bytes]:
         """Read a byte range of a sealed segment (serving cross-node pulls).
@@ -120,7 +305,8 @@ class ShmObjectStore:
         escape, so resolve the path and require it to name a tracked
         object (O(1): the oid is the path suffix). A well-formed path whose
         object was deleted mid-transfer returns None — the puller maps that
-        to ObjectLostError, same as a vanished segment."""
+        to ObjectLostError, same as a vanished segment. Spilled objects
+        serve straight from the spill file without restoring."""
         real = os.path.realpath(path)
         base = os.path.basename(real)
         marker = self._base_prefix + "_"
@@ -129,15 +315,30 @@ class ShmObjectStore:
         oid_hex = base[len(marker):]
         with self._lock:
             entry = self._objects.get(oid_hex)
-        if entry is None or not entry[2]:
-            return None  # deleted (or never sealed): lost, not an attack
+            # wait out an in-flight spill/restore: reading a path that is
+            # about to be unlinked would misreport a live object as lost
+            while entry is not None and entry.state in ("spilling", "restoring"):
+                self._sealed_cv.wait(1.0)
+                entry = self._objects.get(oid_hex)
+            if entry is None or not entry.sealed:
+                return None  # deleted (or never sealed): lost, not an attack
+            entry.last_access = time.monotonic()
+            read_path = entry.path if entry.in_shm else entry.spill_path
         try:
-            fd = os.open(entry[0], os.O_RDONLY)
+            fd = os.open(read_path, os.O_RDONLY)
         except OSError:
             return None
         try:
             os.lseek(fd, offset, os.SEEK_SET)
-            return os.read(fd, length)
+            parts = []
+            got = 0
+            while got < length:
+                b = os.read(fd, length - got)
+                if not b:
+                    break  # EOF: short read surfaces as a partial chunk
+                parts.append(b)
+                got += len(b)
+            return b"".join(parts)
         finally:
             os.close(fd)
 
@@ -145,16 +346,27 @@ class ShmObjectStore:
         with self._lock:
             return self._used, self._capacity
 
+    def spill_stats(self) -> Dict[str, int]:
+        with self._lock:
+            spilled = [e for e in self._objects.values() if not e.in_shm]
+            return {
+                "spilled_objects": len(spilled),
+                "spilled_bytes": self._spilled_bytes,
+            }
+
     def shutdown(self) -> None:
         with self._lock:
             entries = list(self._objects.values())
             self._objects.clear()
             self._used = 0
-        for path, _, _ in entries:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            self._spilled_bytes = 0
+        for e in entries:
+            for p in (e.path, e.spill_path):
+                if p:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
 
 
 class ShmClient:

@@ -311,6 +311,19 @@ class CoreWorker:
         # owner side: task_id hex -> worker address currently executing it
         self._inflight_push: Dict[str, str] = {}
         self._reattach_lock = threading.Lock()
+        # lineage (reference object_recovery_manager.h:26 + task_manager.h
+        # lineage bookkeeping): task_id hex -> [spec, strategy,
+        # live_return_count] for re-executing the creating task when its
+        # objects are lost. Bounded by BYTES of retained arg frames (the
+        # reference bounds lineage the same way) as well as entry count;
+        # entries drop when every return of the task has been deleted.
+        self._lineage: "OrderedDict[str, List[Any]]" = OrderedDict()
+        self._lineage_bytes = 0
+        self._lineage_lock = threading.Lock()
+        # single-flight guard: task_id hex -> Event set when re-execution done
+        self._reconstructing: Dict[str, threading.Event] = {}
+        # actor_id -> max_task_retries (lazily fetched from the actor record)
+        self._actor_retry_cache: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # identity / context
@@ -480,23 +493,55 @@ class CoreWorker:
                 raise GetTimeoutError(
                     f"get() on {ref.id.hex()} timed out after {timeout_s}s"
                 ) from None
-            return self._materialize(stored)
+            try:
+                return self._materialize(stored)
+            except (ObjectLostError, RpcConnectionError):
+                # the value's segment is gone (hosting node died): lineage
+                # reconstruction re-executes the creating task. The re-run
+                # may overrun a short get timeout — recovery is bounded by
+                # the task, not the caller's poll interval (reference
+                # recovery is likewise asynchronous w.r.t. the get).
+                if not self.reconstruct_object(ref.id):
+                    raise
+                stored = self.memory_store.get(ref.id, timeout_s)
+                return self._materialize(stored)
         client = self.workers.get(ref.owner_address)
-        try:
-            reply = client.call(
-                "get_object", oid_hex=ref.id.hex(), wait_s=timeout_s,
-                requester_agent=self.node_agent_address,
-                timeout_s=(timeout_s + 30.0) if timeout_s is not None else 86400.0,
-            )
-        except RpcTimeout:
-            raise GetTimeoutError(
-                f"get() on {ref.id.hex()} timed out after {timeout_s}s"
-            ) from None
-        except RpcConnectionError as e:
-            raise ObjectLostError(
-                f"owner of {ref.id.hex()} at {ref.owner_address} is unreachable: {e}"
-            ) from None
-        return self._materialize_reply(reply)
+        for attempt in range(2):
+            try:
+                reply = client.call(
+                    "get_object", oid_hex=ref.id.hex(), wait_s=timeout_s,
+                    requester_agent=self.node_agent_address,
+                    timeout_s=(timeout_s + 30.0) if timeout_s is not None else 86400.0,
+                )
+            except RpcTimeout:
+                raise GetTimeoutError(
+                    f"get() on {ref.id.hex()} timed out after {timeout_s}s"
+                ) from None
+            except RpcConnectionError as e:
+                raise ObjectLostError(
+                    f"owner of {ref.id.hex()} at {ref.owner_address} is "
+                    f"unreachable: {e}"
+                ) from None
+            try:
+                return self._materialize_reply(reply)
+            except (ObjectLostError, RpcConnectionError):
+                # segment pull failed (hosting node died): ask the OWNER to
+                # reconstruct from lineage, then re-fetch once. Bounded by
+                # the caller's remaining timeout when one was given.
+                if attempt > 0:
+                    raise
+                recon_timeout = 600.0 if timeout_s is None else max(
+                    1.0, timeout_s
+                )
+                try:
+                    ok = client.call(
+                        "reconstruct_object", oid_hex=ref.id.hex(),
+                        timeout_s=recon_timeout,
+                    )
+                except RpcError:
+                    ok = False
+                if not ok:
+                    raise
 
     def _materialize(self, stored: Any) -> Any:
         if isinstance(stored, (bytes, bytearray, memoryview)):
@@ -510,7 +555,7 @@ class CoreWorker:
                     stored.path, stored.size, stored.agent_address
                 )
                 return serialization.unpack(data)
-            view = self.shm.read_view(stored.path, stored.size)
+            view = self._read_local_segment(stored.path, stored.size)
             return serialization.unpack(view)
         if isinstance(stored, TaskError):
             raise stored
@@ -526,7 +571,7 @@ class CoreWorker:
             return serialization.unpack(payload)
         if kind == "plasma":
             path, size = payload
-            view = self.shm.read_view(path, size)
+            view = self._read_local_segment(path, size)
             return serialization.unpack(view)
         if kind == "remote_plasma":
             # Object lives in another host's shm store: pull it in chunks
@@ -539,27 +584,77 @@ class CoreWorker:
             raise payload
         raise RuntimeError(f"unexpected get_object reply kind {kind}")
 
+    def _read_local_segment(self, path: str, size: int) -> memoryview:
+        """mmap a same-host segment; if the file is gone the store spilled
+        it — ask the agent for the meta (get_meta restores spilled
+        segments into shm) and retry. Bounded retries: under heavy
+        spill/restore thrash the restored segment can be re-spilled
+        before our mmap lands."""
+        oid_hex = path.rsplit("_", 1)[-1]
+        for _ in range(4):
+            try:
+                return self.shm.read_view(path, size)
+            except FileNotFoundError:
+                pass
+            meta = self.agent.call(
+                "get_object_meta", oid_hex=oid_hex, timeout_s=60.0,
+            )
+            if meta is None:
+                raise ObjectLostError(f"segment {path} is gone from the store")
+            path, size = meta
+        raise ObjectLostError(
+            f"segment {path} kept vanishing (spill/restore thrash)"
+        )
+
     def _pull_remote_segment(
         self, path: str, size: int, agent_address: str
     ) -> memoryview:
-        chunk = config.object_transfer_chunk_size
+        """Chunked pull with a sliding window of chunk RPCs in flight
+        (parity: reference PushManager/PullManager pipelining,
+        src/ray/object_manager/push_manager.h:28 — one-at-a-time round
+        trips made a 1 GiB object ~1,000 serial RPCs). Objects past the
+        large-object threshold stream into a disk-backed mmap instead of
+        one giant heap bytearray."""
+        chunk = int(config.object_transfer_chunk_size)
+        window = max(1, int(config.object_transfer_window))
         agent = self.agents.get(agent_address)
-        buf = bytearray(size)
-        off = 0
-        while off < size:
-            n = min(chunk, size - off)
-            piece = agent.call(
-                "read_object_chunk", path=path, offset=off, length=n,
-                timeout_s=60.0,
-            )
-            if not piece:
-                # None (file gone) or b'' (segment shorter than recorded —
-                # truncated/replaced): either way the object is lost.
+        if size >= int(config.object_pull_disk_threshold):
+            import tempfile
+
+            f = tempfile.TemporaryFile(prefix="rtpull_")
+            f.truncate(max(size, 1))
+            import mmap as mmap_mod
+
+            mm = mmap_mod.mmap(f.fileno(), max(size, 1))
+            f.close()  # mapping keeps the (anonymous-after-close) file alive
+            buf: Any = mm
+        else:
+            buf = bytearray(size)
+        offsets = list(range(0, size, chunk))
+        inflight: "OrderedDict[int, Any]" = OrderedDict()
+        next_idx = 0
+        done = 0
+        while done < len(offsets):
+            while next_idx < len(offsets) and len(inflight) < window:
+                off = offsets[next_idx]
+                n = min(chunk, size - off)
+                inflight[off] = agent.call_async(
+                    "read_object_chunk", path=path, offset=off, length=n,
+                )
+                next_idx += 1
+            off, pending = next(iter(inflight.items()))
+            del inflight[off]
+            piece = pending.wait(60.0)
+            expected = min(chunk, size - off)
+            if not piece or len(piece) != expected:
+                # None (file gone) or short (segment truncated/replaced):
+                # either way the object is lost. A gap must never be
+                # silently zero-filled.
                 raise ObjectLostError(
                     f"remote segment {path} vanished during transfer"
                 )
             buf[off:off + len(piece)] = piece
-            off += len(piece)
+            done += 1
         return memoryview(buf)  # no copy; unpack accepts buffer views
 
     def wait(
@@ -628,6 +723,7 @@ class CoreWorker:
     def delete_owned_object(self, oid: ObjectID) -> None:
         stored = self.memory_store.try_get(oid)
         self.memory_store.delete(oid)
+        self._drop_lineage_return(oid)
         if isinstance(stored, PlasmaValue):
             try:
                 self.agents.get(stored.agent_address).call_oneway(
@@ -688,8 +784,88 @@ class CoreWorker:
             name=options.name or fn_name,
         )
         strategy = self._resolve_strategy(options.scheduling_strategy)
+        with self._lineage_lock:
+            self._lineage[task_id.hex()] = [spec, strategy, options.num_returns]
+            self._lineage_bytes += len(spec.args_frame)
+            while len(self._lineage) > int(config.lineage_max_entries) or (
+                self._lineage_bytes > int(config.lineage_max_bytes)
+                and len(self._lineage) > 1
+            ):
+                _, dropped = self._lineage.popitem(last=False)
+                self._lineage_bytes -= len(dropped[0].args_frame)
         self._submit_pool.submit(self._submit_normal_task, spec, strategy)
         return refs
+
+    def _drop_lineage_return(self, oid: ObjectID) -> None:
+        """An owned object was deleted: its task's lineage entry loses a
+        live return; at zero the entry (and its retained args) drops."""
+        task_hex = oid.task_id().hex()
+        with self._lineage_lock:
+            entry = self._lineage.get(task_hex)
+            if entry is None:
+                return
+            entry[2] -= 1
+            if entry[2] <= 0:
+                self._lineage.pop(task_hex, None)
+                self._lineage_bytes -= len(entry[0].args_frame)
+
+    def _object_really_lost(self, oid: ObjectID) -> bool:
+        """Distinguish a dead segment from a transient blip: if the
+        hosting agent still answers and holds the object, do NOT
+        re-execute (a reconstruction over a live value would race the
+        existing segment)."""
+        stored = self.memory_store.try_get(oid)
+        if not isinstance(stored, PlasmaValue):
+            return not os_mod.is_missing(stored) and isinstance(
+                stored, LostValue
+            )
+        try:
+            return not self.agents.get(stored.agent_address).call(
+                "object_contains", oid_hex=oid.hex(), timeout_s=5.0,
+            )
+        except RpcError:
+            return True  # agent unreachable: treat as lost
+
+    def reconstruct_object(self, oid: ObjectID) -> bool:
+        """Re-execute the task that created oid (lineage reconstruction,
+        reference object_recovery_manager.h:26). Single-flight per task;
+        returns True if the value is available again (either a
+        re-execution ran, one was joined, or the loss turned out to be a
+        transient failure and the value is intact)."""
+        task_hex = oid.task_id().hex()
+        with self._lineage_lock:
+            entry = self._lineage.get(task_hex)
+            if entry is None:
+                return False
+            event = self._reconstructing.get(task_hex)
+            if event is None:
+                event = threading.Event()
+                self._reconstructing[task_hex] = event
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            event.wait(timeout=600.0)
+            return True
+        try:
+            if not self._object_really_lost(oid):
+                return True
+            spec, strategy = entry[0], entry[1]
+            logger.warning(
+                "reconstructing lost object %s by re-executing task %s",
+                oid.hex()[:16], spec.name,
+            )
+            self._submit_normal_task(spec, strategy)
+            return True
+        finally:
+            event.set()
+            with self._lineage_lock:
+                self._reconstructing.pop(task_hex, None)
+
+    def rpc_reconstruct_object(self, conn, oid_hex: str):
+        """Borrower-triggered reconstruction: a remote reader failed to
+        pull our object's segment (hosting node died)."""
+        return self.reconstruct_object(ObjectID.from_hex(oid_hex))
 
     def _resolve_strategy(self, strategy):
         """Convert API strategy objects into the wire dict form."""
@@ -907,6 +1083,7 @@ class CoreWorker:
             "namespace": actor_options.get("namespace", "default"),
             "lifetime": actor_options.get("lifetime"),
             "max_restarts": actor_options.get("max_restarts", 0),
+            "max_task_retries": actor_options.get("max_task_retries", 0),
             "max_concurrency": actor_options.get("max_concurrency", 1),
             "method_names": actor_options.get("method_names", []),
             "scheduling_strategy": self._resolve_strategy(
@@ -953,6 +1130,18 @@ class CoreWorker:
                 raise ActorUnavailableError(f"actor {actor_id} is {info['state']}")
             time.sleep(0.05)
 
+    def _actor_max_task_retries(self, actor_id: str) -> int:
+        n = self._actor_retry_cache.get(actor_id)
+        if n is not None:
+            return n
+        try:
+            info = self.control.call("get_actor_info", actor_id=actor_id)
+            n = int((info or {}).get("max_task_retries") or 0)
+        except RpcError:
+            n = 0
+        self._actor_retry_cache[actor_id] = n
+        return n
+
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs,
                           num_returns: int = 1) -> List[ObjectRef]:
         task_id = TaskID.for_actor_task(ActorID.from_hex(actor_id))
@@ -968,6 +1157,10 @@ class CoreWorker:
             num_returns=num_returns,
             owner_address=self.address,
             resources={},
+            # opt-in at-least-once for actor methods (reference
+            # task_manager.h max_task_retries): connection-loss failures
+            # are re-submitted to the restarted actor up to this many times
+            max_retries=self._actor_max_task_retries(actor_id),
             actor_id=actor_id,
             method_name=method_name,
             name=f"{actor_id[:8]}.{method_name}",
@@ -1269,6 +1462,7 @@ class _ActorSender:
         self.actor_id = actor_id
         self.specs: "queue.Queue" = queue.Queue()
         self.inflight: "queue.Queue" = queue.Queue()
+        self.attempts: Dict[str, int] = {}  # task_id hex -> retries used
         self._sender = threading.Thread(
             target=self._send_loop, name=f"actor-send-{actor_id[:8]}", daemon=True
         )
@@ -1280,6 +1474,25 @@ class _ActorSender:
 
     def submit(self, spec: TaskSpec) -> None:
         self.specs.put(spec)
+
+    def _maybe_retry(self, spec: TaskSpec, err: Exception) -> bool:
+        """Actor max_task_retries: re-queue a call that failed on
+        connection loss while the actor restarts (at-least-once — the
+        method may have executed; only opt-in via max_task_retries,
+        reference task_manager.h:175). Permanent death never retries."""
+        if spec.max_retries <= 0 or not isinstance(err, ActorUnavailableError):
+            return False
+        attempts = self.attempts.get(spec.task_id.hex(), 0)
+        if attempts >= spec.max_retries:
+            self.attempts.pop(spec.task_id.hex(), None)
+            return False
+        self.attempts[spec.task_id.hex()] = attempts + 1
+        logger.warning(
+            "retrying actor task %s (attempt %d/%d) after: %s",
+            spec.name, attempts + 1, spec.max_retries, err,
+        )
+        self.specs.put(spec)
+        return True
 
     def _send_loop(self) -> None:
         w = self.worker
@@ -1313,7 +1526,9 @@ class _ActorSender:
                     w._store_actor_task_failure(spec, e)
                     break
             else:
-                w._store_actor_task_failure(spec, w._actor_connection_lost(spec))
+                err = w._actor_connection_lost(spec)
+                if not self._maybe_retry(spec, err):
+                    w._store_actor_task_failure(spec, err)
 
     def _wait_loop(self) -> None:
         w = self.worker
@@ -1324,8 +1539,11 @@ class _ActorSender:
                 continue
             try:
                 reply = pending.wait(None)
+                self.attempts.pop(spec.task_id.hex(), None)
                 w._store_task_reply(spec, reply)
             except (RpcConnectionError, RpcTimeout):
-                w._store_actor_task_failure(spec, w._actor_connection_lost(spec))
+                err = w._actor_connection_lost(spec)
+                if not self._maybe_retry(spec, err):
+                    w._store_actor_task_failure(spec, err)
             except Exception as e:  # noqa: BLE001
                 w._store_actor_task_failure(spec, e)

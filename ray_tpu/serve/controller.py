@@ -244,11 +244,16 @@ class ServeController:
             except RpcConnectionError:
                 # connection loss is ambiguous (worker rebinding, network
                 # blip, or real death) — weigh it heavier than a timeout
-                # but do not kill a healthy replica on one strike
+                # but do not kill a healthy replica on one strike. Drop the
+                # cached address so the next probe re-resolves: a replica
+                # that restarted at a NEW address must not be probed at the
+                # old one forever (and a truly dead one resolves to
+                # ActorDiedError next round for immediate removal).
+                w._actor_addr_cache.pop(rec["handle"]._actor_id, None)
                 with self._lock:
                     rec["probe_misses"] = rec.get("probe_misses", 0) + 3
                     dead = rec["probe_misses"] >= 6
-            except (RpcError, Exception):  # noqa: BLE001 — slow or dying
+            except Exception:  # noqa: BLE001 — slow or dying
                 with self._lock:
                     rec["probe_misses"] = rec.get("probe_misses", 0) + 1
                     dead = rec["probe_misses"] >= 6  # ~30s unresponsive

@@ -103,12 +103,22 @@ config.define("object_store_memory_mb", 1024)
 # Cross-node object transfer chunk size (reference C8 push/pull: 1MB
 # chunks, object_manager.proto); larger here since transport is TCP.
 config.define("object_transfer_chunk_size", 4 * 1024 * 1024)
+# Sliding window of chunk RPCs in flight per pull (reference
+# push_manager.h pipelining).
+config.define("object_transfer_window", 8)
+# Pulls at/above this size stream into a disk-backed mmap instead of a
+# heap bytearray (bounding worker RSS for huge objects).
+config.define("object_pull_disk_threshold", 256 * 1024 * 1024)
 config.define("worker_register_timeout_s", 30.0)
 config.define("worker_pool_prestart", 0)
 config.define("worker_idle_timeout_s", 600.0)
 config.define("scheduler_spread_threshold", 0.5)
 config.define("task_max_retries", 3)
 config.define("borrow_pin_ttl_s", 600.0)
+# Owner-side lineage entries kept for object reconstruction (reference
+# bounds lineage by bytes; we bound by task count).
+config.define("lineage_max_entries", 10000)
+config.define("lineage_max_bytes", 256 * 1024 * 1024)
 config.define("actor_max_restarts", 0)
 config.define("log_to_driver", True)
 config.define("temp_dir", "/tmp/ray_tpu")
