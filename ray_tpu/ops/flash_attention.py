@@ -249,16 +249,20 @@ def flash_attention(q, k, v, causal: bool = True):
     return out
 
 
-def _flash_fwd(q, k, v, causal):
+def _flash_fwd(q, k, v, causal, out_dtype=None):
     B, T, H, D = q.shape
+    Tk = k.shape[1]
+    if causal and Tk != T:
+        raise ValueError("causal flash attention requires Tq == Tk")
     qf, kf, vf = _fold(q), _fold(k), _fold(v)
     BH = B * H
-    bq, bk = _block_sizes(T)
-    grid = (BH, T // bq, T // bk)
+    bq, _ = _block_sizes(T)
+    _, bk = _block_sizes(Tk)
+    grid = (BH, T // bq, Tk // bk)
     out, lse = pl.pallas_call(
         functools.partial(
             _fwd_kernel, block_q=bq, block_k=bk, causal=causal,
-            single_k=(T // bk == 1),
+            single_k=(Tk // bk == 1),
         ),
         grid=grid,
         in_specs=[
@@ -271,7 +275,7 @@ def _flash_fwd(q, k, v, causal):
             pl.BlockSpec((None, 8, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((BH, 8, T), jnp.float32),
         ],
         scratch_shapes=[
@@ -289,19 +293,19 @@ def _flash_fwd_rule(q, k, v, causal):
     return _flash_fwd(q, k, v, causal)
 
 
-def _flash_bwd_rule(causal, res, dout):
-    q, k, v, out_f, lse = res
-    B, T, H, D = q.shape
-    qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(dout)
-    BH = B * H
-    # delta = rowsum(dO * O), on the same 8-row sublane layout as lse
-    delta = jnp.sum(dof.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[:, None, :], (BH, 8, T))
-
-    bq, bk = _block_sizes(T)
+def _bwd_kernels(qf, kf, vf, dof, lse, delta, causal, q_dtype, k_dtype,
+                 v_dtype):
+    """dq + (dk, dv) pallas calls on folded [BH, T, D] operands. Tq and Tk
+    may differ (ring attention feeds visiting K/V blocks); lse and delta
+    are the GLOBAL log-sum-exp / rowsum(dO*O) for the q rows, which is
+    exactly what the flash decomposition needs per block."""
+    BH, Tq, D = qf.shape
+    Tk = kf.shape[1]
+    bq, _ = _block_sizes(Tq)
+    _, bk = _block_sizes(Tk)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_q=bq, block_k=bk, causal=causal),
-        grid=(BH, T // bq, T // bk),
+        grid=(BH, Tq // bq, Tk // bk),
         in_specs=[
             pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
@@ -311,7 +315,7 @@ def _flash_bwd_rule(causal, res, dout):
             pl.BlockSpec((None, 8, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q_dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=_params(),
         interpret=_interpret(),
@@ -319,7 +323,7 @@ def _flash_bwd_rule(causal, res, dout):
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, block_q=bq, block_k=bk, causal=causal),
-        grid=(BH, T // bk, T // bq),
+        grid=(BH, Tk // bk, Tq // bq),
         in_specs=[
             pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
@@ -333,8 +337,8 @@ def _flash_bwd_rule(causal, res, dout):
             pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), k_dtype),
+            jax.ShapeDtypeStruct((BH, Tk, D), v_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),
@@ -343,8 +347,52 @@ def _flash_bwd_rule(causal, res, dout):
         compiler_params=_params(),
         interpret=_interpret(),
     )(qf, kf, vf, dof, lse, delta)
+    return dq, dk, dv
 
+
+def _flash_bwd_rule(causal, res, dout):
+    q, k, v, out_f, lse = res
+    B, T, H, D = q.shape
+    qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(dout)
+    BH = B * H
+    # delta = rowsum(dO * O), on the same 8-row sublane layout as lse
+    delta = jnp.sum(dof.astype(jnp.float32) * out_f.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (BH, 8, T))
+    dq, dk, dv = _bwd_kernels(
+        qf, kf, vf, dof, lse, delta, causal, q.dtype, k.dtype, v.dtype
+    )
     return _unfold(dq, B, H), _unfold(dk, B, H), _unfold(dv, B, H)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Block-level entry points for ring attention (ops/ring_attention.py):
+# one K/V block visits per ring step; outputs merge via the global lse.
+# ---------------------------------------------------------------------------
+
+
+def flash_fwd_block(q, k, v, causal: bool):
+    """One (q-shard, kv-block) flash forward.
+
+    q [B,Tq,H,D], k/v [B,Tk,H,D] (Tk may differ when causal=False) ->
+    (o [B,Tq,H,D] fp32, normalized within the block, lse [B*H, 8, Tq]).
+    fp32 output: the ring merges blocks in fp32, and rounding each
+    block's o before the merge would lose the fp32-accumulation guarantee
+    the monolithic kernel has across its K tiles."""
+    out, (_, _, _, _, lse) = _flash_fwd(q, k, v, causal, out_dtype=jnp.float32)
+    return out, lse
+
+
+def flash_bwd_block(q, k, v, do, lse, delta, causal: bool):
+    """Per-block backward against the GLOBAL lse/delta: returns this
+    block's (dq-contribution, dk, dv), in fp32 (the ring accumulates
+    across blocks; one downcast happens at the very end)."""
+    B, Tq, H, D = q.shape
+    qf, kf, vf, dof = _fold(q), _fold(k), _fold(v), _fold(do)
+    f32 = jnp.float32
+    dq, dk, dv = _bwd_kernels(
+        qf, kf, vf, dof, lse, delta, causal, f32, f32, f32
+    )
+    return _unfold(dq, B, H), _unfold(dk, B, H), _unfold(dv, B, H)

@@ -3,20 +3,31 @@
 Sequence-parallel exact attention for sequences too long for one chip:
 each device holds a T/n slice of Q, K, V; K/V blocks rotate around the
 ring via lax.ppermute (nearest-neighbor ICI hops) while every device
-accumulates its queries' attention over all blocks with streaming-softmax
-(running max/sum) merging — numerically identical to full attention.
+accumulates its queries' attention over all blocks, merging block
+results through their log-sum-exp — numerically identical to full
+attention.
 
 The reference has NO equivalent (SURVEY.md §5 "long-context": it
 delegates sequence scaling to vLLM/DeepSpeed); this is a required
-capability-parity addition, built TPU-first: the rotation is compiled to
-collective-permute on ICI and overlaps with the block computation.
+capability-parity addition, built TPU-first.
 
-Round-1 block computation is the einsum form (differentiable end-to-end
-through the ring; per-shard score blocks are [B, H, T/n, T/n]); swapping
-in the Pallas flash kernel per block is a planned optimization.
+Block math runs in the Pallas flash kernel (ops/flash_attention.py
+flash_fwd_block / flash_bwd_block): no [Tq, Tk] score tensor ever hits
+HBM. The whole ring is a jax.custom_vjp: the forward ring saves (q, k,
+v, o, global lse); the backward runs a second ring in which each
+visiting block's (dk, dv) accumulators travel WITH the block, so after a
+full rotation every block arrives home carrying gradient contributions
+from every rank's queries (the standard ring-attention backward).
+
+Ring-step visibility under causal masking (global positions):
+  src == my  -> the diagonal block: causal flash kernel
+  src <  my  -> fully visible: non-causal flash kernel
+  src >  my  -> fully masked: skipped (zero output, -inf lse)
 
 Usage: inside shard_map with q, k, v sharded on T over axis_name, or via
 ring_attention_sharded() which applies the shard_map given a mesh.
+`block_impl="einsum"` keeps the readable einsum block math as a numerics
+oracle for tests.
 """
 
 from __future__ import annotations
@@ -28,7 +39,146 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.ops import flash_attention as fa
+
 _NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# flash-block ring (custom VJP)
+# ---------------------------------------------------------------------------
+
+
+def _lse_to_btH1(lse, B, H):
+    """[B*H, 8, Tl] sublane-layout lse -> [B, Tl, H, 1] merge weights."""
+    Tl = lse.shape[-1]
+    return lse[:, 0, :].reshape(B, H, Tl).transpose(0, 2, 1)[..., None]
+
+
+def _ring_cases(src, my, causal, diag_fn, full_fn, skip_fn):
+    if not causal:
+        return full_fn()
+    return lax.cond(
+        src == my,
+        diag_fn,
+        lambda: lax.cond(src < my, full_fn, skip_fn),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention(
+    q: jax.Array,  # local shard [B, Tl, H, Dh]
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "cp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention across the ring; call under shard_map with the
+    sequence dim sharded over `axis_name`."""
+    out, _ = _ring_fwd(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal):
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    BH = B * H
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        o_acc, lse_run, kk, vv = carry
+        src = (my - s) % n
+
+        def diag():
+            return fa.flash_fwd_block(q, kk, vv, causal=True)
+
+        def full():
+            return fa.flash_fwd_block(q, kk, vv, causal=False)
+
+        def skip():
+            return (
+                jnp.zeros((B, Tl, H, D), jnp.float32),
+                jnp.full((BH, 8, Tl), _NEG, jnp.float32),
+            )
+
+        o_b, lse_b = _ring_cases(src, my, causal, diag, full, skip)
+        # merge via lse: o = sum_b o_b * exp(lse_b - lse_global)
+        lse_new = jnp.logaddexp(lse_run, lse_b)
+        w_run = jnp.exp(lse_run - lse_new)
+        w_b = jnp.exp(lse_b - lse_new)
+        o_acc = (
+            o_acc * _lse_to_btH1(w_run, B, H)
+            + o_b * _lse_to_btH1(w_b, B, H)
+        )
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (o_acc, lse_new, kk, vv), None
+
+    o0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    lse0 = jnp.full((BH, 8, Tl), _NEG, jnp.float32)
+    (o_acc, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(n))
+    out = o_acc.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, res, do):
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    BH = B * H
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    # delta = rowsum(dO * O) in the kernel's 8-row sublane layout
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, Tl, H]
+    delta = delta.transpose(0, 2, 1).reshape(BH, Tl)
+    delta = jnp.broadcast_to(delta[:, None, :], (BH, 8, Tl))
+
+    def step(carry, s):
+        dq_acc, kk, vv, dk_acc, dv_acc = carry
+        src = (my - s) % n
+
+        def diag():
+            return fa.flash_bwd_block(q, kk, vv, do, lse, delta, causal=True)
+
+        def full():
+            return fa.flash_bwd_block(q, kk, vv, do, lse, delta, causal=False)
+
+        def skip():
+            z = jnp.zeros((B, Tl, H, D), jnp.float32)
+            return z, z, z
+
+        dq_b, dk_b, dv_b = _ring_cases(src, my, causal, diag, full, skip)
+        dq_acc = dq_acc + dq_b
+        dk_acc = dk_acc + dk_b
+        dv_acc = dv_acc + dv_b
+        # the visiting block AND its gradient accumulators rotate together;
+        # after n steps each block is home with every rank's contribution
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+        dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+        return (dq_acc, kk, vv, dk_acc, dv_acc), None
+
+    dq0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    dkv0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k, v, dkv0, dkv0), jnp.arange(n)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(
+    lambda q, k, v, axis_name, causal: _ring_fwd(q, k, v, axis_name, causal),
+    _ring_bwd,
+)
+
+
+# ---------------------------------------------------------------------------
+# einsum block math (numerics oracle; differentiable end-to-end via autodiff)
+# ---------------------------------------------------------------------------
 
 
 def _block_scores(q, kb, q_off, k_off, causal):
@@ -45,15 +195,13 @@ def _block_scores(q, kb, q_off, k_off, causal):
     return s  # [B, H, Tq, Tk] fp32
 
 
-def ring_attention(
+def ring_attention_einsum(
     q: jax.Array,  # local shard [B, Tl, H, Dh]
     k: jax.Array,
     v: jax.Array,
     axis_name: str = "cp",
     causal: bool = True,
 ) -> jax.Array:
-    """Exact attention across the ring; call under shard_map with the
-    sequence dim sharded over `axis_name`."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
@@ -105,6 +253,7 @@ def ring_attention_sharded(
     cp_axis: str = "cp",
     batch_axes=("dcn", "dp", "fsdp"),
     head_axis: Optional[str] = "tp",
+    block_impl: str = "flash",
 ) -> jax.Array:
     """shard_map wrapper: T over cp, batch over data axes, heads over tp."""
     from jax.sharding import PartitionSpec as P
@@ -116,7 +265,8 @@ def ring_attention_sharded(
 
     batch = tuple(a for a in batch_axes if a in mesh.shape)
     spec = P(batch if batch else None, cp_axis, head_axis, None)
-    fn = functools.partial(ring_attention, axis_name=cp_axis, causal=causal)
+    impl = ring_attention if block_impl == "flash" else ring_attention_einsum
+    fn = functools.partial(impl, axis_name=cp_axis, causal=causal)
     return _shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

@@ -1,0 +1,34 @@
+"""ray_tpu.tune — hyperparameter tuning.
+
+Parity target: Ray Tune (reference python/ray/tune — Tuner + trial
+controller over actors, search spaces, ASHA early stopping, per-trial
+checkpoints).
+"""
+
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.session import get_trial_dir, load_checkpoint, report
+from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "ResultGrid",
+    "TrialResult",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_trial_dir",
+    "grid_search",
+    "load_checkpoint",
+    "loguniform",
+    "randint",
+    "report",
+    "uniform",
+]

@@ -1,0 +1,75 @@
+"""Trial schedulers.
+
+Parity: ray.tune schedulers (reference python/ray/tune/schedulers/ —
+FIFOScheduler, AsyncHyperBandScheduler/ASHA async_hyperband.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """No early stopping: every trial runs to its own completion."""
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous Successive Halving (reference async_hyperband.py):
+    rungs at grace_period * reduction_factor^k; when a trial first reports
+    at/past a rung, its metric joins the rung's record and the trial stops
+    unless it is in the rung's top 1/reduction_factor fraction."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 3,
+        time_attr: str = "training_iteration",
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> list of recorded metric values
+        self._rung_records: Dict[int, List[float]] = {r: [] for r in self.rungs}
+        # trial_id -> highest rung already judged
+        self._judged: Dict[str, int] = {}
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP  # ran to the horizon
+        for rung in reversed(self.rungs):
+            if t < rung or self._judged.get(trial_id, -1) >= rung:
+                continue
+            self._judged[trial_id] = rung
+            records = self._rung_records[rung]
+            records.append(float(value))
+            if len(records) < self.rf:
+                return CONTINUE  # not enough peers to judge yet
+            ordered = sorted(records, reverse=(self.mode == "max"))
+            k = max(1, len(ordered) // self.rf)
+            cutoff = ordered[k - 1]
+            good = value >= cutoff if self.mode == "max" else value <= cutoff
+            return CONTINUE if good else STOP
+        return CONTINUE

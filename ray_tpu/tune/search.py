@@ -1,0 +1,105 @@
+"""Search spaces + trial generation.
+
+Parity: ray.tune search-space API (reference python/ray/tune/search/ —
+sample.py domains, BasicVariantGenerator grid/random expansion).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self._lo, self._hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self._lo, self._hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, options: Sequence[Any]):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+class GridSearch:
+    """Marker: expands the cross-product instead of sampling."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(options: Sequence[Any]) -> Choice:
+    return Choice(options)
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(values)
+
+
+def generate_trials(
+    param_space: Dict[str, Any],
+    num_samples: int,
+    seed: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Expand grid_search dims into their cross-product; sample Domain
+    dims num_samples times per grid point (reference BasicVariantGenerator
+    semantics)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+    grids: List[Dict[str, Any]] = [{}]
+    for k in grid_keys:
+        grids = [
+            {**g, k: v} for g in grids for v in param_space[k].values
+        ]
+    trials = []
+    for g in grids:
+        for _ in range(num_samples):
+            cfg = dict(g)
+            for k, v in param_space.items():
+                if k in cfg:
+                    continue
+                cfg[k] = v.sample(rng) if isinstance(v, Domain) else v
+            trials.append(cfg)
+    return trials

@@ -1,0 +1,233 @@
+"""Tuner + trial controller.
+
+Parity: ray.tune Tuner (reference python/ray/tune/tuner.py:43) and
+TuneController (tune/execution/tune_controller.py:67 — event-loop step
+:665, trial-actor scheduling :963): trials run in actors, the controller
+polls their buffered results, feeds the scheduler, and stops losers
+early; per-trial checkpoints land under the run dir.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search import generate_trials
+from ray_tpu.utils import serialization
+
+logger = logging.getLogger(__name__)
+
+
+class TuneConfig:
+    def __init__(
+        self,
+        metric: str = "score",
+        mode: str = "max",
+        num_samples: int = 1,
+        max_concurrent_trials: int = 4,
+        scheduler=None,
+        seed: Optional[int] = None,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_concurrent_trials = max_concurrent_trials
+        self.scheduler = scheduler or sched_mod.FIFOScheduler()
+        self.seed = seed
+
+
+class TrialResult:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self.metrics: Optional[Dict[str, Any]] = None  # last report
+        self.all_reports: List[Dict[str, Any]] = []
+        self.error: Optional[str] = None
+        self.stopped_early = False
+        self.checkpoint_path: Optional[str] = None
+
+    def __repr__(self):
+        return (
+            f"TrialResult({self.trial_id}, metrics={self.metrics}, "
+            f"stopped_early={self.stopped_early}, error={self.error})"
+        )
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    def get_best_result(self) -> TrialResult:
+        scored = [
+            r for r in self._results
+            if r.metrics and self._metric in r.metrics
+        ]
+        if not scored:
+            raise ValueError("no trial reported the target metric")
+        return (max if self._mode == "max" else min)(
+            scored, key=lambda r: r.metrics[self._metric]
+        )
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for r in self._results if r.error)
+
+
+@ray_tpu.remote
+class _TrialRunner:
+    """Hosts one trial; buffers reports for the controller to drain."""
+
+    def __init__(self, fn_blob: bytes, config: Dict[str, Any], trial_dir: str):
+        import threading
+
+        from ray_tpu.tune import session
+
+        self._fn = serialization.loads(fn_blob)
+        self._config = config
+        self._trial_dir = trial_dir
+        self._reports: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._done = False
+        self._error: Optional[str] = None
+        self._session = session
+
+    def run(self) -> bool:
+        """Executes the trainable to completion (or until killed)."""
+        from ray_tpu.tune import session
+
+        session._set(self._on_report, self._trial_dir, self._config)
+        try:
+            self._fn(self._config)
+            return True
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self._error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            with self._lock:
+                self._done = True
+            session._set(None, None, None)
+
+    def _on_report(self, metrics: Dict[str, Any]) -> None:
+        with self._lock:
+            self._reports.append(metrics)
+
+    def drain(self):
+        with self._lock:
+            out = self._reports
+            self._reports = []
+            return {"reports": out, "done": self._done, "error": self._error}
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], None],
+        *,
+        param_space: Dict[str, Any],
+        tune_config: Optional[TuneConfig] = None,
+        run_dir: Optional[str] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = param_space
+        self._cfg = tune_config or TuneConfig()
+        self._run_dir = run_dir or os.path.join(
+            "/tmp/ray_tpu", "tune", f"run_{uuid.uuid4().hex[:8]}"
+        )
+
+    def fit(self) -> ResultGrid:
+        cfg = self._cfg
+        configs = generate_trials(
+            self._param_space, cfg.num_samples, seed=cfg.seed
+        )
+        fn_blob = serialization.dumps_function(self._trainable)
+        pending = [
+            (f"trial_{i:04d}", c) for i, c in enumerate(configs)
+        ]
+        results = {tid: TrialResult(tid, c) for tid, c in pending}
+        running: Dict[str, Dict[str, Any]] = {}  # tid -> {actor, run_ref}
+        os.makedirs(self._run_dir, exist_ok=True)
+
+        def launch(tid: str, config: Dict[str, Any]) -> None:
+            trial_dir = os.path.join(self._run_dir, tid)
+            os.makedirs(trial_dir, exist_ok=True)
+            # max_concurrency=2: run() occupies one execution thread for
+            # the trial's lifetime; drain() needs the other.
+            actor = _TrialRunner.options(max_concurrency=2).remote(
+                fn_blob, config, trial_dir
+            )
+            running[tid] = {
+                "actor": actor,
+                "run_ref": actor.run.remote(),
+                "iter": 0,
+            }
+
+        def finish(tid: str, stopped_early: bool = False,
+                   error: Optional[str] = None) -> None:
+            rec = running.pop(tid)
+            res = results[tid]
+            res.stopped_early = stopped_early
+            if error:
+                res.error = error
+            try:
+                ray_tpu.kill(rec["actor"])
+            except Exception:  # noqa: BLE001
+                pass
+            ckpts = sorted(
+                d for d in os.listdir(os.path.join(self._run_dir, tid))
+                if d.startswith("checkpoint_")
+            ) if os.path.isdir(os.path.join(self._run_dir, tid)) else []
+            if ckpts:
+                res.checkpoint_path = os.path.join(self._run_dir, tid, ckpts[-1])
+
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent_trials:
+                tid, config = pending.pop(0)
+                launch(tid, config)
+            time.sleep(0.1)
+            for tid in list(running):
+                rec = running[tid]
+                try:
+                    state = ray_tpu.get(
+                        rec["actor"].drain.remote(), timeout=30
+                    )
+                except Exception as e:  # noqa: BLE001 — runner died
+                    finish(tid, error=f"trial runner died: {e}")
+                    continue
+                res = results[tid]
+                decision = sched_mod.CONTINUE
+                for report in state["reports"]:
+                    rec["iter"] += 1
+                    report.setdefault("training_iteration", rec["iter"])
+                    res.all_reports.append(report)
+                    res.metrics = report
+                    decision = cfg.scheduler.on_result(tid, report)
+                    if decision == sched_mod.STOP:
+                        break
+                if state["done"] or state["error"]:
+                    # drain any error; natural completion
+                    finish(tid, error=state["error"])
+                elif decision == sched_mod.STOP:
+                    logger.info("early-stopping trial %s", tid)
+                    finish(tid, stopped_early=True)
+        return ResultGrid(
+            list(results.values()), cfg.metric, cfg.mode
+        )

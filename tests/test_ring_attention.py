@@ -118,3 +118,22 @@ def test_flash_attention_cpu_interpret(cpu_mesh_devices):
     )(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_einsum_oracle_matches(mesh, causal):
+    """The einsum block-math variant stays as a numerics oracle."""
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.attention import _reference_attention
+    from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+    B, T, H, D = 2, 64, 4, 32
+    q, k, v = _rand_qkv((B, T, H, D), jnp.float32)
+    ref = _reference_attention(q, k, v, causal)
+    out = ring_attention_sharded(
+        q, k, v, mesh, causal=causal, block_impl="einsum"
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(out), rtol=2e-5, atol=2e-5
+    )
