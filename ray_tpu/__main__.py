@@ -1,0 +1,7 @@
+"""`python -m ray_tpu <cmd>` — forwards to the rt CLI (ray_tpu/cli.py)."""
+
+import sys
+
+from ray_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,115 @@
+"""rt — the cluster CLI.
+
+Parity: the `ray` CLI's observability commands (reference
+python/ray/scripts/scripts.py — status, list, timeline :2171). Run as
+`python -m ray_tpu.cli <cmd>` (or `python -m ray_tpu <cmd>`); point it
+at a cluster with --address or RT_ADDRESS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _fmt_table(rows: List[dict], columns: List[str]) -> str:
+    if not rows:
+        return "(none)"
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in columns
+    }
+    head = "  ".join(c.upper().ljust(widths[c]) for c in columns)
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        lines.append(
+            "  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns)
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rt", description="ray_tpu cluster CLI"
+    )
+    parser.add_argument(
+        "--address", default=os.environ.get("RT_ADDRESS"),
+        help="control store host:port (default: $RT_ADDRESS)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="cluster summary")
+    listp = sub.add_parser("list", help="list cluster entities")
+    listp.add_argument(
+        "what",
+        choices=["nodes", "actors", "jobs", "workers", "placement-groups"],
+    )
+    tl = sub.add_parser("timeline", help="dump a Chrome-trace timeline")
+    tl.add_argument("--out", default="timeline.json")
+    sub.add_parser("metrics", help="aggregated user metrics (Prometheus text)")
+    args = parser.parse_args(argv)
+
+    from ray_tpu import state
+
+    addr = args.address
+    if args.cmd == "status":
+        st = state.cluster_status(addr)
+        if args.as_json:
+            print(json.dumps(st, indent=2))
+        else:
+            res = st["resources_total"]
+            avail = st["resources_available"]
+            print(f"nodes: {st['nodes_alive']} alive, {st['nodes_dead']} dead")
+            print(f"workers: {st['workers']}")
+            print(
+                "actors: "
+                + ", ".join(f"{k}={v}" for k, v in st["actors"].items())
+            )
+            for k in sorted(res):
+                print(f"  {k}: {avail.get(k, 0.0):g}/{res[k]:g} available")
+            obj = st["object_store"]
+            print(
+                f"object store: {obj['used_bytes']:,}/"
+                f"{obj['capacity_bytes']:,} bytes used, "
+                f"{obj['spilled_objects']} objects "
+                f"({obj['spilled_bytes']:,} bytes) spilled"
+            )
+        return 0
+    if args.cmd == "list":
+        what = args.what
+        fetch = {
+            "nodes": (state.list_nodes, ["node_id", "address", "alive"]),
+            "actors": (
+                state.list_actors,
+                ["actor_id", "class_name", "state", "name", "num_restarts"],
+            ),
+            "jobs": (state.list_jobs, ["job_id", "driver_address", "alive"]),
+            "workers": (
+                state.list_workers, ["worker_id", "node_id", "pid", "state"],
+            ),
+            "placement-groups": (
+                state.list_placement_groups, ["pg_id", "strategy", "state"],
+            ),
+        }[what]
+        rows = fetch[0](addr)
+        if args.as_json:
+            print(json.dumps(rows, indent=2, default=str))
+        else:
+            print(_fmt_table(rows, fetch[1]))
+        return 0
+    if args.cmd == "timeline":
+        path = state.timeline(addr, out_path=args.out)
+        print(f"wrote {path} (open in chrome://tracing or ui.perfetto.dev)")
+        return 0
+    if args.cmd == "metrics":
+        from ray_tpu.utils import metrics as metrics_mod
+
+        print(metrics_mod.prometheus_text(state.cluster_metrics(addr)), end="")
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Mutable shared-memory channels — the compiled-graph data plane.
+
+Parity: the reference's experimental mutable-object channels
+(python/ray/experimental/channel/shared_memory_channel.py over
+src/ray/core_worker/experimental_mutable_object_manager.cc): a
+pre-allocated shm segment REUSED for every message, so a static actor
+loop (e.g. pipeline microbatches between co-located stages) pays one
+mmap once and then a memcpy + seqlock flip per message instead of
+object-store create/seal/get RPCs.
+
+Single-writer single-reader, same host. Layout:
+  [seq u64][len u64][payload ...]
+The writer bumps seq AFTER the payload is fully written; the reader
+spins (with backoff) until seq advances past what it last consumed,
+then copies the payload out before validating seq is unchanged
+(torn-read guard).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import time
+import uuid
+from typing import Optional
+
+_HDR = struct.Struct("<QQ")  # seq, payload_len
+_SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+
+
+class ShmChannel:
+    def __init__(self, path: str, capacity: int, create: bool = False):
+        self.path = path
+        self.capacity = capacity
+        total = _HDR.size + capacity
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(path, flags, 0o600)
+        try:
+            if create:
+                os.ftruncate(fd, total)
+            self._mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        if create:
+            self._mm[: _HDR.size] = _HDR.pack(0, 0)
+        self._last_read = int.from_bytes(self._mm[0:8], "little")
+
+    @classmethod
+    def create(cls, capacity: int = 4 * 1024 * 1024) -> "ShmChannel":
+        path = os.path.join(_SHM_DIR, f"rtchan_{uuid.uuid4().hex[:16]}")
+        return cls(path, capacity, create=True)
+
+    @classmethod
+    def attach(cls, path: str, capacity: int) -> "ShmChannel":
+        return cls(path, capacity, create=False)
+
+    def handle(self):
+        """Picklable (path, capacity) to hand to the peer actor."""
+        return {"path": self.path, "capacity": self.capacity}
+
+    @classmethod
+    def from_handle(cls, handle) -> "ShmChannel":
+        return cls.attach(handle["path"], handle["capacity"])
+
+    # -- writer --------------------------------------------------------
+
+    def write(self, payload: bytes) -> None:
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"payload {len(payload)} > channel capacity {self.capacity}"
+            )
+        seq = int.from_bytes(self._mm[0:8], "little")
+        self._mm[_HDR.size: _HDR.size + len(payload)] = payload
+        self._mm[8:16] = len(payload).to_bytes(8, "little")
+        # publish: bump seq last (release on x86/ARM via GIL + mmap)
+        self._mm[0:8] = (seq + 1).to_bytes(8, "little")
+
+    # -- reader --------------------------------------------------------
+
+    def read(self, timeout_s: Optional[float] = 30.0) -> bytes:
+        """Block until a message newer than the last one read arrives."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        spins = 0
+        while True:
+            seq = int.from_bytes(self._mm[0:8], "little")
+            if seq > self._last_read:
+                length = int.from_bytes(self._mm[8:16], "little")
+                data = bytes(self._mm[_HDR.size: _HDR.size + length])
+                seq2 = int.from_bytes(self._mm[0:8], "little")
+                if seq2 == seq:
+                    self._last_read = seq
+                    return data
+                # torn read (writer overwrote mid-copy): retry
+                continue
+            spins += 1
+            if spins > 1000:
+                time.sleep(0.0005)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.path}: no message")
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass

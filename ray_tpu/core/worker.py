@@ -29,7 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import object_store as os_mod
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -324,6 +324,10 @@ class CoreWorker:
         self._reconstructing: Dict[str, threading.Event] = {}
         # actor_id -> max_task_retries (lazily fetched from the actor record)
         self._actor_retry_cache: Dict[str, int] = {}
+        # task execution events for the timeline (reference
+        # task_event_buffer.cc -> GcsTaskManager -> `ray timeline`):
+        # bounded ring of {name, task_id, ts_us, dur_us, status}
+        self._task_events: deque = deque(maxlen=10000)
 
     # ------------------------------------------------------------------
     # identity / context
@@ -1305,6 +1309,7 @@ class CoreWorker:
         self._current_ctx.task_id = spec.task_id
         self._current_ctx.job_id = spec.task_id.job_id()
         self._running_tasks[spec.task_id.hex()] = {"name": spec.name}
+        _t0 = time.time()
         try:
             if spec.actor_id is not None:
                 rt = self._actor_runtime
@@ -1333,6 +1338,26 @@ class CoreWorker:
         finally:
             self._running_tasks.pop(spec.task_id.hex(), None)
             self._current_ctx.task_id = None
+            self._task_events.append({
+                "name": spec.name or spec.fn_name,
+                "task_id": spec.task_id.hex(),
+                "actor_id": spec.actor_id,
+                "ts_us": int(_t0 * 1e6),
+                "dur_us": int((time.time() - _t0) * 1e6),
+                "worker": self.address,
+                "pid": os.getpid(),
+            })
+
+    def rpc_get_task_events(self, conn, clear: bool = False):
+        events = list(self._task_events)
+        if clear:
+            self._task_events.clear()
+        return events
+
+    def rpc_get_metrics(self, conn):
+        from ray_tpu.utils import metrics as metrics_mod
+
+        return metrics_mod.snapshot_all()
 
     def _resolve_arg(self, value: Any) -> Any:
         if isinstance(value, ObjectRef):
@@ -1461,7 +1486,12 @@ class _ActorSender:
         self.worker = worker
         self.actor_id = actor_id
         self.specs: "queue.Queue" = queue.Queue()
-        self.inflight: "queue.Queue" = queue.Queue()
+        # (pending, spec) pairs whose reply/failure has LANDED: populated
+        # by per-call done-callbacks, so replies are processed in
+        # COMPLETION order — a long-running call (an actor method that
+        # blocks for minutes) must not head-of-line block the replies of
+        # later calls that already finished on other executor threads.
+        self.completed: "queue.Queue" = queue.Queue()
         self.attempts: Dict[str, int] = {}  # task_id hex -> retries used
         self._sender = threading.Thread(
             target=self._send_loop, name=f"actor-send-{actor_id[:8]}", daemon=True
@@ -1514,7 +1544,9 @@ class _ActorSender:
                     addr = w._resolve_actor_address(spec.actor_id, timeout_s=3600.0)
                     client = w.workers.get(addr)
                     pending = client.call_async("actor_task", spec=spec)
-                    self.inflight.put((pending, spec))
+                    pending.add_done_callback(
+                        lambda p, s=spec: self.completed.put((p, s))
+                    )
                     break
                 except (RpcConnectionError, RpcTimeout):
                     w._actor_addr_cache.pop(spec.actor_id, None)
@@ -1534,11 +1566,11 @@ class _ActorSender:
         w = self.worker
         while not w._shutdown.is_set():
             try:
-                pending, spec = self.inflight.get(timeout=0.5)
+                pending, spec = self.completed.get(timeout=0.5)
             except queue.Empty:
                 continue
             try:
-                reply = pending.wait(None)
+                reply = pending.wait(0)  # already done: no blocking
                 self.attempts.pop(spec.task_id.hex(), None)
                 w._store_task_reply(spec, reply)
             except (RpcConnectionError, RpcTimeout):

@@ -1,0 +1,207 @@
+"""Cluster state API.
+
+Parity: ray.util.state (reference python/ray/util/state/api.py) + the
+`ray timeline` exporter (scripts.py:2171): list nodes/actors/jobs/
+placement groups/workers/tasks, aggregate metrics, and dump a
+Chrome-trace timeline of task execution events collected from every
+worker's event buffer.
+
+Functions accept an explicit control-store address, or use the connected
+runtime's when omitted.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.utils.rpc import RpcClient, RpcError
+
+
+def _control(address: Optional[str]) -> RpcClient:
+    if address is None:
+        from ray_tpu.core import worker as worker_mod
+
+        w = worker_mod.global_worker_or_none()
+        if w is None:
+            raise RuntimeError(
+                "not connected: pass address= or call ray_tpu.init() first"
+            )
+        address = w.control_address
+    return RpcClient(address, name="state-api")
+
+
+def _with_control(address, fn):
+    client = _control(address)
+    try:
+        return fn(client)
+    finally:
+        client.close()
+
+
+def list_nodes(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _with_control(
+        address, lambda c: c.call("get_nodes", alive_only=False)
+    )
+
+
+def list_actors(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _with_control(address, lambda c: c.call("list_actors"))
+
+
+def list_jobs(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _with_control(address, lambda c: c.call("list_jobs"))
+
+
+def list_placement_groups(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _with_control(address, lambda c: c.call("list_placement_groups"))
+
+
+def _agent_states(address: Optional[str]) -> List[Dict[str, Any]]:
+    nodes = [n for n in list_nodes(address) if n.get("alive", True)]
+    out = []
+    for n in nodes:
+        client = RpcClient(n["address"], name="state-api-agent")
+        try:
+            out.append(client.call("get_state", timeout_s=10.0))
+        except RpcError:
+            pass
+        finally:
+            client.close()
+    return out
+
+
+def list_workers(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    out = []
+    for st in _agent_states(address):
+        for wid, w in st.get("workers", {}).items():
+            out.append({"worker_id": wid, "node_id": st["node_id"], **w})
+    return out
+
+
+def cluster_status(address: Optional[str] = None) -> Dict[str, Any]:
+    """`rt status` summary: nodes, resources, stores, actors, jobs."""
+    nodes = list_nodes(address)
+    agents = _agent_states(address)
+    actors = list_actors(address)
+    total: Dict[str, float] = {}
+    avail: Dict[str, float] = {}
+    for st in agents:
+        for k, v in st["resources_total"].items():
+            total[k] = total.get(k, 0.0) + v
+        for k, v in st["resources_available"].items():
+            avail[k] = avail.get(k, 0.0) + v
+    return {
+        "nodes_alive": sum(1 for n in nodes if n.get("alive", True)),
+        "nodes_dead": sum(1 for n in nodes if not n.get("alive", True)),
+        "resources_total": total,
+        "resources_available": avail,
+        "actors": {
+            "ALIVE": sum(1 for a in actors if a["state"] == "ALIVE"),
+            "DEAD": sum(1 for a in actors if a["state"] == "DEAD"),
+            "other": sum(
+                1 for a in actors if a["state"] not in ("ALIVE", "DEAD")
+            ),
+        },
+        "workers": sum(len(st.get("workers", {})) for st in agents),
+        "object_store": {
+            "used_bytes": sum(st["store_usage"][0] for st in agents),
+            "capacity_bytes": sum(st["store_usage"][1] for st in agents),
+            "spilled_objects": sum(
+                st.get("spill_stats", {}).get("spilled_objects", 0)
+                for st in agents
+            ),
+            "spilled_bytes": sum(
+                st.get("spill_stats", {}).get("spilled_bytes", 0)
+                for st in agents
+            ),
+        },
+    }
+
+
+def _worker_addresses(address: Optional[str]) -> List[str]:
+    addrs = []
+    for st in _agent_states(address):
+        for w in st.get("workers", {}).values():
+            addrs.append(w["address"])
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker_or_none()
+    if w is not None:
+        addrs.append(w.address)  # the driver executes nothing but owns events
+    return addrs
+
+
+def task_events(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Collect task execution events from every live worker."""
+    events: List[Dict[str, Any]] = []
+    for addr in _worker_addresses(address):
+        client = RpcClient(addr, name="state-api-worker")
+        try:
+            events.extend(client.call("get_task_events", timeout_s=10.0))
+        except RpcError:
+            pass
+        finally:
+            client.close()
+    return events
+
+
+def timeline(address: Optional[str] = None,
+             out_path: Optional[str] = None) -> Any:
+    """Chrome-trace (chrome://tracing / perfetto) of task executions
+    (parity: `ray timeline`, reference scripts.py:2171)."""
+    events = task_events(address)
+    trace = [
+        {
+            "name": e["name"],
+            "cat": "actor_task" if e.get("actor_id") else "task",
+            "ph": "X",
+            "ts": e["ts_us"],
+            "dur": e["dur_us"],
+            "pid": e["worker"],
+            "tid": e.get("pid", 0),
+            "args": {"task_id": e["task_id"]},
+        }
+        for e in events
+    ]
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+        return out_path
+    return trace
+
+
+def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:
+    """Aggregate user metrics (utils/metrics.py) across all workers:
+    counters/histograms sum, gauges keep the latest per series."""
+    merged: Dict[str, Dict] = {}
+    for addr in _worker_addresses(address):
+        client = RpcClient(addr, name="state-api-metrics")
+        try:
+            snap = client.call("get_metrics", timeout_s=10.0)
+        except RpcError:
+            continue
+        finally:
+            client.close()
+        for name, m in snap.items():
+            cur = merged.get(name)
+            if cur is None:
+                merged[name] = m
+                continue
+            for k, v in m["series"].items():
+                if m["kind"] == "counter":
+                    cur["series"][k] = cur["series"].get(k, 0.0) + v
+                elif m["kind"] == "gauge":
+                    cur["series"][k] = v
+                else:  # histogram
+                    prev = cur["series"].get(k)
+                    if prev is None:
+                        cur["series"][k] = v
+                    else:
+                        prev["sum"] += v["sum"]
+                        prev["count"] += v["count"]
+                        prev["buckets"] = [
+                            a + b
+                            for a, b in zip(prev["buckets"], v["buckets"])
+                        ]
+    return merged

@@ -130,11 +130,16 @@ class _TrialRunner:
         with self._lock:
             self._reports.append(metrics)
 
-    def drain(self):
+    def drain(self, cursor: int = 0):
+        """Reports from `cursor` onward. NON-destructive: a reply that the
+        controller times out on and discards is re-fetched by the next
+        drain (the cursor only advances after a delivered reply)."""
         with self._lock:
-            out = self._reports
-            self._reports = []
-            return {"reports": out, "done": self._done, "error": self._error}
+            return {
+                "reports": self._reports[cursor:],
+                "done": self._done,
+                "error": self._error,
+            }
 
 
 class Tuner:
@@ -178,6 +183,7 @@ class Tuner:
                 "actor": actor,
                 "run_ref": actor.run.remote(),
                 "iter": 0,
+                "cursor": 0,
             }
 
         def finish(tid: str, stopped_early: bool = False,
@@ -206,9 +212,21 @@ class Tuner:
             for tid in list(running):
                 rec = running[tid]
                 try:
+                    # Short per-poll timeout: a wedged runner must not
+                    # head-of-line block the serial poll loop; the miss
+                    # budget (~2min) decides wedged-vs-slow. The cursor
+                    # makes a timed-out-then-completed drain harmless —
+                    # its reports are re-fetched next round.
                     state = ray_tpu.get(
-                        rec["actor"].drain.remote(), timeout=30
+                        rec["actor"].drain.remote(rec["cursor"]), timeout=5
                     )
+                    rec["drain_misses"] = 0
+                    rec["cursor"] += len(state["reports"])
+                except ray_tpu.exceptions.GetTimeoutError:
+                    rec["drain_misses"] = rec.get("drain_misses", 0) + 1
+                    if rec["drain_misses"] >= 24:
+                        finish(tid, error="trial runner unresponsive")
+                    continue
                 except Exception as e:  # noqa: BLE001 — runner died
                     finish(tid, error=f"trial runner died: {e}")
                     continue

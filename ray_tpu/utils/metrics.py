@@ -1,0 +1,158 @@
+"""User-facing metrics API: Counter / Gauge / Histogram.
+
+Parity: ray.util.metrics (reference python/ray/util/metrics.py:42).
+Metrics register in a per-process registry; any process serves its
+snapshot over the worker RPC (rpc_get_metrics) and the state API
+aggregates across the cluster — the role the reference's OpenCensus →
+dashboard-agent → Prometheus pipeline plays, without the Prometheus
+dependency (a /metrics text formatter is provided for scraping).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_lock = threading.Lock()
+_registry: Dict[str, "_Metric"] = {}
+
+_DEFAULT_BOUNDARIES = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+)
+
+
+class _Metric:
+    kind = "metric"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        # tag-value tuple -> value state
+        self._series: Dict[Tuple[str, ...], object] = {}
+        with _lock:
+            existing = _registry.get(name)
+            if existing is not None and existing.kind != self.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {existing.kind}"
+                )
+            _registry[name] = self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        tags = tags or {}
+        return tuple(str(tags.get(k, "")) for k in self.tag_keys)
+
+    def snapshot(self) -> Dict:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "description": self.description,
+                "tag_keys": self.tag_keys,
+                "series": {k: v for k, v in self._series.items()},
+            }
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._series[self._key(tags)] = float(value)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "description": self.description,
+                "tag_keys": self.tag_keys,
+                "series": {k: v for k, v in self._series.items()},
+            }
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = _DEFAULT_BOUNDARIES,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            state = self._series.get(k)
+            if state is None:
+                state = {
+                    "buckets": [0] * (len(self.boundaries) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+                self._series[k] = state
+            idx = bisect.bisect_left(list(self.boundaries), value)
+            state["buckets"][idx] += 1
+            state["sum"] += value
+            state["count"] += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "kind": self.kind,
+                "description": self.description,
+                "tag_keys": self.tag_keys,
+                "boundaries": self.boundaries,
+                "series": {
+                    k: dict(v, buckets=list(v["buckets"]))
+                    for k, v in self._series.items()
+                },
+            }
+
+
+def snapshot_all() -> Dict[str, Dict]:
+    with _lock:
+        metrics = list(_registry.values())
+    return {m.name: m.snapshot() for m in metrics}
+
+
+def prometheus_text(snapshots: Dict[str, Dict]) -> str:
+    """Render aggregated snapshots in Prometheus exposition format."""
+    lines: List[str] = []
+    for name, snap in sorted(snapshots.items()):
+        lines.append(f"# HELP {name} {snap.get('description', '')}")
+        kind = snap["kind"] if snap["kind"] != "histogram" else "histogram"
+        lines.append(f"# TYPE {name} {kind}")
+        for tagvals, value in snap["series"].items():
+            labels = ",".join(
+                f'{k}="{v}"' for k, v in zip(snap["tag_keys"], tagvals) if v
+            )
+            label_s = "{" + labels + "}" if labels else ""
+            if snap["kind"] == "histogram":
+                lines.append(f"{name}_count{label_s} {value['count']}")
+                lines.append(f"{name}_sum{label_s} {value['sum']}")
+            else:
+                lines.append(f"{name}{label_s} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def _reset_for_tests() -> None:
+    with _lock:
+        _registry.clear()

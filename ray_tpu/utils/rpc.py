@@ -489,17 +489,43 @@ class RpcClient:
 
 
 class _PendingCall:
-    __slots__ = ("event", "ok", "payload")
+    __slots__ = ("event", "ok", "payload", "_cbs", "_cb_lock", "_done")
 
     def __init__(self):
         self.event = threading.Event()
         self.ok = False
         self.payload = None
+        self._cbs = []
+        self._cb_lock = threading.Lock()
+        self._done = False
 
     def set(self, ok: bool, payload: Any) -> None:
         self.ok = ok
         self.payload = payload
         self.event.set()
+        with self._cb_lock:
+            self._done = True
+            cbs, self._cbs = self._cbs, []
+        self._run_cbs(cbs)
+
+    def add_done_callback(self, cb) -> None:
+        """Invoke cb(self) once the reply (or failure) lands; every
+        registered callback fires exactly once, including ones added
+        after completion (concurrent.futures semantics). Runs on the
+        client read-loop thread — keep it cheap (enqueue, don't
+        process)."""
+        with self._cb_lock:
+            if not self._done:
+                self._cbs.append(cb)
+                return
+        self._run_cbs([cb])
+
+    def _run_cbs(self, cbs) -> None:
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — must not kill the read loop
+                pass
 
     def wait(self, timeout_s: Optional[float] = None) -> Any:
         if not self.event.wait(timeout_s):

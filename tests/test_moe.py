@@ -1,0 +1,106 @@
+"""MoE expert-parallel tests on the CPU mesh (SURVEY §2.4 EP row —
+capability the reference delegates to vLLM; native here)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ep_mesh(cpu_mesh_devices):
+    from ray_tpu.parallel import MeshConfig, build_mesh
+
+    return build_mesh(MeshConfig(dp=2, ep=4))
+
+
+def _setup(E=8, D=16, F=32, B=32, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    x = jax.random.normal(ks[0], (B, D), jnp.float32)
+    wg = jax.random.normal(ks[1], (D, E), jnp.float32) * 0.5
+    w_in = jax.random.normal(ks[2], (E, D, F), jnp.float32) * 0.1
+    w_out = jax.random.normal(ks[3], (E, F, D), jnp.float32) * 0.1
+    return x, wg, w_in, w_out
+
+
+def test_router_dispatch_shapes_and_capacity(cpu_mesh_devices):
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.moe import router_dispatch
+
+    x, wg, _, _ = _setup(B=16)
+    dispatch, combine = router_dispatch(x, wg, capacity=4, top_k=2)
+    assert dispatch.shape == (16, 8, 4)
+    # every slot holds at most one token
+    assert float(dispatch.sum(axis=0).max()) <= 1.0 + 1e-6
+    # each token occupies at most top_k slots
+    assert float(dispatch.sum(axis=(1, 2)).max()) <= 2.0 + 1e-6
+    # combine weights of each token sum to <= 1 (== 1 when not dropped)
+    s = combine.sum(axis=(1, 2))
+    assert float(s.max()) <= 1.0 + 1e-5
+
+
+def test_moe_local_routes_to_right_experts(cpu_mesh_devices):
+    """With an identity-ish router forcing one expert, output must equal
+    that expert's FFN applied to the tokens."""
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.moe import moe_block_local
+
+    x, _, w_in, w_out = _setup(B=8)
+    E, D = 8, 16
+    x = jnp.abs(x) + 0.1  # all-positive tokens
+    # router whose expert-3 logit is 10*sum(x) > 0 while others are 0:
+    # expert 3 wins for every token
+    wg = jnp.zeros((D, E)).at[:, 3].set(10.0)
+    out = moe_block_local(x, wg, w_in, w_out, capacity=8, top_k=1)
+    import jax
+
+    expected = jax.nn.gelu(x.astype(jnp.float32) @ w_in[3]) @ w_out[3]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_moe_sharded_matches_local(ep_mesh):
+    """Expert-parallel all_to_all path == per-shard local oracle."""
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.moe import moe_block_local, moe_block_sharded
+
+    x, wg, w_in, w_out = _setup(B=32)
+    C = 8
+    out = moe_block_sharded(x, wg, w_in, w_out, ep_mesh, capacity=C)
+    # oracle: same routing/capacity computed per token shard, all experts
+    # local (expert math is per-token, so results must be identical)
+    shards = [
+        moe_block_local(x[i * 8:(i + 1) * 8], wg, w_in, w_out, capacity=C)
+        for i in range(4)
+    ]
+    expected = jnp.concatenate(shards, axis=0)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expected), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_moe_sharded_differentiable(ep_mesh):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.moe import moe_block_sharded
+
+    x, wg, w_in, w_out = _setup(B=32)
+
+    def loss(x, wg, w_in, w_out):
+        out = moe_block_sharded(x, wg, w_in, w_out, ep_mesh, capacity=8)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2, 3))(
+        x, wg, w_in, w_out
+    )
+    assert np.isfinite(float(val))
+    for g in grads:
+        assert bool(jnp.isfinite(g).all())
+    # expert weights actually receive gradient
+    assert float(jnp.abs(grads[2]).sum()) > 0

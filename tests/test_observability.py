@@ -1,0 +1,117 @@
+"""Metrics / state API / timeline / CLI tests (parity model:
+python/ray/tests/test_state_api.py, test_metrics_agent.py subset)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_metrics_api_local():
+    from ray_tpu.utils import metrics
+
+    metrics._reset_for_tests()
+    c = metrics.Counter("req_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = metrics.Gauge("queue_len")
+    g.set(7)
+    h = metrics.Histogram("lat_s", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    snap = metrics.snapshot_all()
+    assert snap["req_total"]["series"][("/a",)] == 3.0
+    assert snap["req_total"]["series"][("/b",)] == 1.0
+    assert snap["queue_len"]["series"][()] == 7.0
+    hs = snap["lat_s"]["series"][()]
+    assert hs["count"] == 3 and hs["buckets"] == [1, 1, 1]
+    text = metrics.prometheus_text(snap)
+    assert 'req_total{route="/a"} 3.0' in text
+    assert "lat_s_count 3" in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_state_api_lists(rt):
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    a = Pinger.options(name="obs_pinger").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and all("node_id" in n for n in nodes)
+    actors = state.list_actors()
+    assert any(x.get("name") == "obs_pinger" for x in actors)
+    workers = state.list_workers()
+    assert len(workers) >= 1
+    st = state.cluster_status()
+    assert st["nodes_alive"] >= 1
+    assert st["actors"]["ALIVE"] >= 1
+    assert st["object_store"]["capacity_bytes"] > 0
+    ray_tpu.kill(a)
+
+
+def test_task_events_and_timeline(rt, tmp_path):
+    @ray_tpu.remote
+    def traced_work(x):
+        return x + 1
+
+    assert ray_tpu.get([traced_work.remote(i) for i in range(3)]) == [1, 2, 3]
+    events = state.task_events()
+    mine = [e for e in events if e["name"] == "traced_work"]
+    assert len(mine) >= 3
+    assert all(e["dur_us"] >= 0 and e["ts_us"] > 0 for e in mine)
+
+    out = str(tmp_path / "trace.json")
+    state.timeline(out_path=out)
+    trace = json.load(open(out))
+    assert any(ev["name"] == "traced_work" and ev["ph"] == "X" for ev in trace)
+
+
+def test_worker_metrics_aggregate(rt):
+    @ray_tpu.remote
+    def work_with_metrics(n):
+        from ray_tpu.utils.metrics import Counter
+
+        c = Counter("obs_work_done", "work items")
+        c.inc(n)
+        return True
+
+    assert all(
+        ray_tpu.get([work_with_metrics.remote(2) for _ in range(3)])
+    )
+    agg = state.cluster_metrics()
+    assert agg["obs_work_done"]["series"][()] == 6.0
+
+
+def test_cli_smoke(rt, tmp_path, capsys):
+    from ray_tpu.cli import main
+    from ray_tpu.core import worker as worker_mod
+
+    addr = worker_mod.global_worker().control_address
+    assert main(["--address", addr, "status"]) == 0
+    out = capsys.readouterr().out
+    assert "nodes: " in out and "object store:" in out
+    assert main(["--address", addr, "list", "nodes"]) == 0
+    assert "NODE_ID" in capsys.readouterr().out
+    assert main(["--address", addr, "--json", "list", "actors"]) == 0
+    json.loads(capsys.readouterr().out)
+    tl = str(tmp_path / "t.json")
+    assert main(["--address", addr, "timeline", "--out", tl]) == 0
+    capsys.readouterr()
+    json.load(open(tl))
+    assert main(["--address", addr, "metrics"]) == 0
