@@ -49,3 +49,13 @@ def _total_memory_bytes() -> int:
     except OSError:
         pass
     return 8 << 30
+
+
+def __getattr__(name):
+    # SlicePlacementGroup lives in its own module to keep discovery
+    # import-light (it pulls in the placement API).
+    if name in ("SlicePlacementGroup", "slice_placement_group"):
+        from ray_tpu.accelerators import slice_pg
+
+        return getattr(slice_pg, name)
+    raise AttributeError(f"module 'ray_tpu.accelerators' has no attribute {name!r}")

@@ -49,6 +49,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     tl = sub.add_parser("timeline", help="dump a Chrome-trace timeline")
     tl.add_argument("--out", default="timeline.json")
     sub.add_parser("metrics", help="aggregated user metrics (Prometheus text)")
+    job = sub.add_parser("job", help="submit / inspect cluster jobs")
+    jobsub = job.add_subparsers(dest="job_cmd", required=True)
+    js = jobsub.add_parser("submit")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run, e.g. -- python train.py")
+    js.add_argument("--wait", action="store_true")
+    for name in ("status", "logs", "stop"):
+        p = jobsub.add_parser(name)
+        p.add_argument("submission_id")
+    jobsub.add_parser("list")
     args = parser.parse_args(argv)
 
     from ray_tpu import state
@@ -108,6 +118,45 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         print(metrics_mod.prometheus_text(state.cluster_metrics(addr)), end="")
         return 0
+    if args.cmd == "job":
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient(addr)
+        if args.job_cmd == "submit":
+            import shlex
+
+            entry = list(args.entrypoint)
+            if entry and entry[0] == "--":  # strip ONLY the separator
+                entry = entry[1:]
+            if not entry:
+                print("no entrypoint given", file=sys.stderr)
+                return 2
+            # shlex.join preserves quoting through the supervisor's shell
+            sid = client.submit_job(entrypoint=shlex.join(entry))
+            print(sid)
+            if args.wait:
+                status = client.wait_until_finished(sid)
+                print(status)
+                return 0 if status == "SUCCEEDED" else 1
+            return 0
+        if args.job_cmd == "status":
+            print(client.get_job_status(args.submission_id))
+            return 0
+        if args.job_cmd == "logs":
+            print(client.get_job_logs(args.submission_id), end="")
+            return 0
+        if args.job_cmd == "stop":
+            print(client.stop_job(args.submission_id))
+            return 0
+        if args.job_cmd == "list":
+            if args.as_json:
+                print(json.dumps(client.list_jobs(), indent=2))
+            else:
+                print(_fmt_table(
+                    client.list_jobs(),
+                    ["submission_id", "status", "entrypoint"],
+                ))
+            return 0
     return 1
 
 

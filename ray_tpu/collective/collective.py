@@ -1,10 +1,13 @@
 """Host-tensor collectives over the control-store KV (the Gloo role).
 
-Algorithm: each op gets a (group, seq) namespace; every rank publishes its
-contribution and polls for peers', then reduces locally — correct and
-dependency-free, O(n²) traffic, intended for small host tensors
-(rendezvous payloads, metrics, gradients of toy models in CI). Device
-tensors should use in-graph mesh collectives instead.
+Algorithm: each op gets a (group, seq) namespace; every rank publishes
+its contribution and awaits peers' via server-side blocking kv_wait
+RPCs issued CONCURRENTLY (no client polling — the control store's KV
+condition variable wakes every waiter on publish), then reduces locally.
+reducescatter exchanges only the per-destination chunks (O(tensor)
+traffic per rank, not a full allreduce). Intended for host tensors
+(rendezvous payloads, metrics, CPU-tier CI); device tensors should use
+in-graph mesh collectives instead.
 """
 
 from __future__ import annotations
@@ -42,9 +45,10 @@ class _GroupState:
         # p2p streams get their own per-(src,dst) counters: collective seq
         # numbers only align across ranks when every rank runs every op.
         self.p2p_counts: Dict[tuple, int] = {}
-        # my published keys, deleted with a 2-op lag (peers of op N have
-        # all read it once op N+2 starts — bounds control-store memory)
-        self.gc_queue: List[str] = []
+        # my published keys, grouped PER OP, deleted with a 2-op lag
+        # (peers of op N have all read its keys once op N+2 starts —
+        # bounds control-store memory)
+        self.gc_queue: List[List[str]] = []
         self.lock = threading.Lock()
 
 
@@ -118,36 +122,68 @@ def _exchange(group: _GroupState, payload: Optional[bytes], tag: str,
             retryable=True,
         )
     if payload is not None and gc:
-        with group.lock:
-            group.gc_queue.append(f"{tag}/{group.rank}")
-            stale = group.gc_queue[:-2]
-            group.gc_queue = group.gc_queue[-2:]
-        for key in stale:
+        _gc_publish(group, [f"{tag}/{group.rank}"])
+    want = ranks if ranks is not None else list(range(group.world_size))
+    out = _await_keys(
+        control, ns, [f"{tag}/{r}" for r in want], timeout_s
+    )
+    missing = [r for r in want if out.get(f"{tag}/{r}") is None]
+    if missing:
+        raise TimeoutError(
+            f"collective {tag} on group {group.name}: ranks {missing} "
+            f"missing after {timeout_s}s"
+        )
+    return {r: out[f"{tag}/{r}"] for r in want}
+
+
+def _await_keys(control, ns: str, keys: List[str],
+                timeout_s: float) -> Dict[str, Optional[bytes]]:
+    """Concurrent server-side blocking kv_waits, with reconnect-and-
+    reissue on transient control-store failures (the old poll loop's
+    retryable=True resilience, kept under the no-polling design)."""
+    import time as _time
+
+    from ray_tpu.utils.rpc import RpcConnectionError, RpcTimeout
+
+    deadline = _time.monotonic() + timeout_s
+    out: Dict[str, Optional[bytes]] = {}
+    remaining_keys = list(keys)
+    while remaining_keys:
+        remaining = max(0.5, deadline - _time.monotonic())
+        pending = {
+            k: control.call_async("kv_wait", ns=ns, key=k, wait_s=remaining)
+            for k in remaining_keys
+        }
+        retry = []
+        for k, p in pending.items():
+            try:
+                out[k] = p.wait(remaining + 30.0)
+            except (RpcConnectionError, RpcTimeout):
+                if _time.monotonic() < deadline:
+                    retry.append(k)
+                else:
+                    out[k] = None
+        remaining_keys = retry
+        if retry:
+            _time.sleep(0.2)  # let the client reconnect
+    return out
+
+
+def _gc_publish(group: _GroupState, keys: List[str]) -> None:
+    """Record this op's published keys; delete the keys of ops at least
+    2 behind (every peer provably read them by then)."""
+    control = _control()
+    ns = _ns(group)
+    with group.lock:
+        group.gc_queue.append(keys)
+        stale_ops = group.gc_queue[:-2]
+        group.gc_queue = group.gc_queue[-2:]
+    for op_keys in stale_ops:
+        for key in op_keys:
             try:
                 control.call_oneway("kv_del", ns=ns, key=key)
             except Exception:  # noqa: BLE001
                 pass
-    want = ranks if ranks is not None else list(range(group.world_size))
-    out: Dict[int, bytes] = {}
-    deadline = time.monotonic() + timeout_s
-    poll = 0.002
-    while len(out) < len(want):
-        for r in want:
-            if r in out:
-                continue
-            val = control.call("kv_get", ns=ns, key=f"{tag}/{r}", retryable=True)
-            if val is not None:
-                out[r] = val
-        if len(out) < len(want):
-            if time.monotonic() > deadline:
-                missing = [r for r in want if r not in out]
-                raise TimeoutError(
-                    f"collective {tag} on group {group.name}: ranks {missing} "
-                    f"missing after {timeout_s}s"
-                )
-            time.sleep(poll)
-            poll = min(poll * 1.5, 0.1)
-    return out
 
 
 def _next_tag(group: _GroupState, op: str) -> str:
@@ -173,17 +209,45 @@ def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
 
 
 def reducescatter(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
-    """Reduce across ranks, return this rank's 1/world_size slice (dim 0)."""
+    """Reduce across ranks, return this rank's 1/world_size slice (dim 0).
+
+    Chunk-scatter algorithm: each rank publishes ONLY the chunk destined
+    for each peer and reads only its own n source chunks — O(tensor)
+    bytes moved per rank, vs the round-2 allreduce-then-slice which moved
+    the whole tensor to every rank."""
     group = _groups[group_name]
     arr = np.asarray(tensor)
-    if arr.shape[0] % group.world_size != 0:
+    n = group.world_size
+    if arr.shape[0] % n != 0:
         raise ValueError(
-            f"dim 0 ({arr.shape[0]}) not divisible by world size "
-            f"{group.world_size}"
+            f"dim 0 ({arr.shape[0]}) not divisible by world size {n}"
         )
-    reduced = allreduce(arr, op, group_name)
-    chunk = reduced.shape[0] // group.world_size
-    return reduced[group.rank * chunk : (group.rank + 1) * chunk]
+    chunk = arr.shape[0] // n
+    control = _control()
+    ns = _ns(group)
+    tag = _next_tag(group, "reducescatter")
+    for dst in range(n):
+        control.call(
+            "kv_put", ns=ns,
+            key=f"{tag}/{dst}/{group.rank}",
+            value=serialization.pack(
+                np.ascontiguousarray(arr[dst * chunk:(dst + 1) * chunk])
+            ),
+            retryable=True,
+        )
+    got = _await_keys(
+        control, ns, [f"{tag}/{group.rank}/{src}" for src in range(n)], 120.0
+    )
+    parts = []
+    for src in range(n):
+        val = got.get(f"{tag}/{group.rank}/{src}")
+        if val is None:
+            raise TimeoutError(
+                f"reducescatter on {group.name}: rank {src} missing"
+            )
+        parts.append(serialization.unpack(val))
+    _gc_publish(group, [f"{tag}/{dst}/{group.rank}" for dst in range(n)])
+    return _REDUCERS[op](parts)
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):

@@ -18,6 +18,7 @@ hook mirrors the Redis-backed FT mode and can be added behind StoreBackend).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -46,14 +47,24 @@ class PGState:
 
 
 class ControlStore:
-    def __init__(self, session_id: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, session_id: str, host: str = "127.0.0.1", port: int = 0,
+                 persistence_path: Optional[str] = None):
         self.session_id = session_id
+        # Pluggable metadata persistence (reference C14: in-memory default
+        # vs Redis FT mode): with a path, the KV and job tables snapshot
+        # to disk and a restarted control store restores them (cluster
+        # membership and worker state re-register via heartbeats).
+        self._persistence_path = persistence_path or (
+            str(config.control_store_persistence_path) or None
+        )
+        self._dirty = False
         self._server = RpcServer("control_store", host, port)
         self._server.register_instance(self)
         self._server.on_disconnect = self._handle_disconnect
 
         self._lock = threading.RLock()
         self._kv: Dict[str, Dict[str, bytes]] = {}
+        self._kv_cv = threading.Condition(self._lock)
         self._nodes: Dict[str, Dict[str, Any]] = {}  # node_id hex -> record
         self._actors: Dict[str, Dict[str, Any]] = {}  # actor_id hex -> record
         self._named_actors: Dict[Tuple[str, str], str] = {}
@@ -74,17 +85,81 @@ class ControlStore:
     # ------------------------------------------------------------------
 
     def start(self) -> None:
+        self._restore()
         self._server.start()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="cs-health", daemon=True
         )
         self._health_thread.start()
+        if self._persistence_path:
+            threading.Thread(
+                target=self._persist_loop, name="cs-persist", daemon=True
+            ).start()
 
     def stop(self) -> None:
         self._stopped.set()
+        self._persist(force=True)
         self._server.stop()
         self._agents.close_all()
         self._workers.close_all()
+
+    # -- persistence (reference C14: gcs_table_storage + store_client) --
+
+    def _restore(self) -> None:
+        if not self._persistence_path or not os.path.exists(
+            self._persistence_path
+        ):
+            return
+        import pickle
+
+        try:
+            with open(self._persistence_path, "rb") as f:
+                snap = pickle.load(f)
+            with self._lock:
+                self._kv = snap.get("kv", {})
+                self._jobs = snap.get("jobs", {})
+                self._next_job = snap.get("next_job", 1)
+            logger.info(
+                "control store restored %d KV namespaces, %d jobs from %s",
+                len(self._kv), len(self._jobs), self._persistence_path,
+            )
+        except Exception:  # noqa: BLE001 — corrupt snapshot: start fresh
+            logger.exception("control store snapshot restore failed")
+
+    def _persist(self, force: bool = False) -> None:
+        if not self._persistence_path or not (self._dirty or force):
+            return
+        import pickle
+
+        with self._lock:
+            snap = {
+                # Collective rendezvous namespaces (coll/*) are
+                # incarnation-scoped: restoring them would satisfy a new
+                # group's barrier/op tags with a dead run's keys and
+                # return stale tensors as wrong results.
+                "kv": {
+                    ns: dict(t) for ns, t in self._kv.items()
+                    if not ns.startswith("coll/")
+                },
+                "jobs": {j: dict(r) for j, r in self._jobs.items()},
+                "next_job": self._next_job,
+            }
+            self._dirty = False
+        tmp = self._persistence_path + ".tmp"
+        try:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(self._persistence_path)),
+                exist_ok=True,
+            )
+            with open(tmp, "wb") as f:
+                pickle.dump(snap, f)
+            os.replace(tmp, self._persistence_path)
+        except OSError:
+            logger.exception("control store snapshot write failed")
+
+    def _persist_loop(self) -> None:
+        while not self._stopped.wait(1.0):
+            self._persist()
 
     @property
     def address(self) -> str:
@@ -127,14 +202,33 @@ class ControlStore:
             if not overwrite and key in table:
                 return False
             table[key] = value
+            self._dirty = True
+            self._kv_cv.notify_all()
             return True
 
     def rpc_kv_get(self, conn, ns: str, key: str):
         with self._lock:
             return self._kv.get(ns, {}).get(key)
 
+    def rpc_kv_wait(self, conn, ns: str, key: str, wait_s: float = 60.0):
+        """Block server-side until the key exists (or timeout); returns
+        the value or None. The collective tier's rendezvous primitive:
+        one blocking RPC replaces a client-side poll loop (the round-2
+        O(n^2)-polling weakness)."""
+        deadline = time.monotonic() + wait_s
+        with self._lock:
+            while True:
+                val = self._kv.get(ns, {}).get(key)
+                if val is not None:
+                    return val
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stopped.is_set():
+                    return None
+                self._kv_cv.wait(min(remaining, 1.0))
+
     def rpc_kv_del(self, conn, ns: str, key: str):
         with self._lock:
+            self._dirty = True
             return self._kv.get(ns, {}).pop(key, None) is not None
 
     def rpc_kv_keys(self, conn, ns: str, prefix: str = ""):
@@ -143,6 +237,7 @@ class ControlStore:
 
     def rpc_kv_del_prefix(self, conn, ns: str, prefix: str = ""):
         with self._lock:
+            self._dirty = True
             table = self._kv.get(ns)
             if table is None:
                 return 0
@@ -275,6 +370,7 @@ class ControlStore:
                 "start_time": time.time(),
                 "alive": True,
             }
+            self._dirty = True
         return job_id.hex()
 
     def rpc_finish_job(self, conn, job_id: str):
@@ -283,6 +379,7 @@ class ControlStore:
             if job:
                 job["alive"] = False
                 job["end_time"] = time.time()
+                self._dirty = True
         # Non-detached actors owned by the job die with it.
         with self._lock:
             doomed = [

@@ -134,6 +134,11 @@ class NodeAgent:
         t = threading.Thread(target=self._heartbeat_loop, name="agent-hb", daemon=True)
         t.start()
         self._threads.append(t)
+        tm = threading.Thread(
+            target=self._memory_monitor_loop, name="agent-oom", daemon=True
+        )
+        tm.start()
+        self._threads.append(tm)
         for _ in range(int(config.worker_pool_prestart)):
             self._spawn_worker()
 
@@ -172,6 +177,62 @@ class NodeAgent:
                     return
             except RpcError:
                 pass
+
+    # ------------------------------------------------------------------
+    # memory monitor / OOM killer (reference C19: MemoryMonitor
+    # src/ray/common/memory_monitor.h:56 + WorkerKillingPolicy
+    # worker_killing_policy.h:33)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _memory_usage_fraction() -> float:
+        """Host memory usage in [0, 1]. Test hook: the
+        testing_memory_usage config (>=0) overrides the real reading."""
+        injected = float(config.testing_memory_usage)
+        if injected >= 0:
+            return injected
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.strip().split()[0])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", info.get("MemFree", 0))
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except (OSError, ValueError):
+            return 0.0
+
+    def _memory_monitor_loop(self) -> None:
+        period = float(config.memory_monitor_period_s)
+        threshold = float(config.memory_usage_threshold)
+        while not self._stopped.wait(period):
+            if self._memory_usage_fraction() < threshold:
+                continue
+            # Kill policy (reference worker_killing_policy: prefer
+            # retriable / newest): the most recently LEASED worker — its
+            # task is the newest work and the most likely to be retried
+            # cleanly; idle pool workers are reaped first of all.
+            victim = None
+            with self._lock:
+                idle = [w for w in self._workers.values() if w.state == "idle"]
+                if idle:
+                    victim = idle[0]
+                    self._workers.pop(victim.worker_id, None)
+                elif self._leases:
+                    newest_lease = next(reversed(self._leases))
+                    info = self._leases.get(newest_lease)
+                    victim = self._workers.get(info["worker_id"]) if info else None
+            if victim is not None:
+                logger.warning(
+                    "memory pressure (%.0f%% used >= %.0f%%): killing "
+                    "worker pid=%s",
+                    self._memory_usage_fraction() * 100, threshold * 100,
+                    victim.pid,
+                )
+                self._terminate_worker(victim)
 
     # ------------------------------------------------------------------
     # worker pool (reference C6)

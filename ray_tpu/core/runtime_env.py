@@ -1,0 +1,168 @@
+"""Runtime environments: per-task/actor env_vars and working_dir.
+
+Parity: the reference runtime-env plugin system (C17/P9 —
+python/ray/_private/runtime_env/{working_dir,...}.py + the per-node
+agent's URI cache). Scope here is the two plugins everything else builds
+on: env_vars (set for the duration of the execution) and working_dir
+(the driver zips the directory into the control-store KV once,
+content-addressed; executors download/extract/cache it and run with it
+as cwd + on sys.path). pip/conda envs are out of scope in this
+no-network image — the by-value cloudpickle of user modules
+(utils/serialization.py) covers driver-local code instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import os
+import sys
+import threading
+import zipfile
+from typing import Any, Dict, Optional
+
+_KV_NS = "runtime_env"
+_MAX_WORKING_DIR_BYTES = 100 * 1024 * 1024
+_cache_lock = threading.Lock()
+_extracted: Dict[str, str] = {}  # digest -> extracted path
+_uploaded: Dict[str, str] = {}  # abs working_dir path -> digest
+
+
+def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str, Any]]:
+    """Driver-side: normalize + upload. working_dir paths become
+    content-addressed KV references, uploaded ONCE per directory path per
+    process (the reference packages a working_dir URI once per job —
+    re-zipping 100MB on every .remote() would turn submission into pure
+    CPU; edit-and-resubmit within one driver process reuses the first
+    upload)."""
+    if not runtime_env:
+        return None
+    out = dict(runtime_env)
+    wd = out.get("working_dir")
+    if wd and not isinstance(wd, dict):
+        wd = os.path.abspath(wd)
+        with _cache_lock:
+            digest = _uploaded.get(wd)
+        if digest is not None:
+            out["working_dir"] = {"kv_key": digest}
+            if out.get("env_vars") is not None:
+                out["env_vars"] = {
+                    str(k): str(v) for k, v in out["env_vars"].items()
+                }
+            return out
+        if not os.path.isdir(wd):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+        buf = io.BytesIO()
+        total = 0
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, dirs, files in os.walk(wd):
+                dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
+                for name in files:
+                    path = os.path.join(root, name)
+                    total += os.path.getsize(path)
+                    if total > _MAX_WORKING_DIR_BYTES:
+                        raise ValueError(
+                            f"working_dir {wd!r} exceeds "
+                            f"{_MAX_WORKING_DIR_BYTES >> 20}MB"
+                        )
+                    zf.write(path, os.path.relpath(path, wd))
+        blob = buf.getvalue()
+        digest = hashlib.sha1(blob).hexdigest()
+        control.call(
+            "kv_put", ns=_KV_NS, key=digest, value=blob, overwrite=False,
+            retryable=True,
+        )
+        with _cache_lock:
+            _uploaded[wd] = digest
+        out["working_dir"] = {"kv_key": digest}
+    env_vars = out.get("env_vars")
+    if env_vars is not None:
+        out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
+    return out
+
+
+def _fetch_working_dir(digest: str, control) -> str:
+    with _cache_lock:
+        path = _extracted.get(digest)
+    if path and os.path.isdir(path):
+        return path
+    blob = control.call("kv_get", ns=_KV_NS, key=digest, retryable=True)
+    if blob is None:
+        raise RuntimeError(f"working_dir blob {digest} missing from KV")
+    target = os.path.join("/tmp", f"rtenv_{digest[:16]}")
+    tmp = target + f".tmp{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        # another worker won the race; use theirs
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    with _cache_lock:
+        _extracted[digest] = target
+    return target
+
+
+def apply_permanent(runtime_env: Optional[Dict[str, Any]], control) -> None:
+    """Executor-side, for actors: the worker process is dedicated to one
+    actor, so its runtime env applies for the process's whole life (no
+    restore). Same semantics as one `apply` entered forever."""
+    if not runtime_env:
+        return
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = v
+    wd = runtime_env.get("working_dir")
+    if isinstance(wd, dict) and "kv_key" in wd:
+        path = _fetch_working_dir(wd["kv_key"], control)
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+
+
+@contextlib.contextmanager
+def apply(runtime_env: Optional[Dict[str, Any]], control):
+    """Executor-side: env vars + working_dir for one execution.
+
+    Env vars are process-wide (worker processes execute at most one
+    runtime-env-bearing task at a time in practice; the reference
+    instead keys whole worker processes by env hash — worker-pool
+    binning is a follow-up)."""
+    if not runtime_env:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd = None
+    added_path = None
+    try:
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        wd = runtime_env.get("working_dir")
+        if isinstance(wd, dict) and "kv_key" in wd:
+            path = _fetch_working_dir(wd["kv_key"], control)
+            saved_cwd = os.getcwd()
+            os.chdir(path)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+                added_path = path
+        yield
+    finally:
+        if saved_cwd is not None:
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+        if added_path is not None:
+            try:
+                sys.path.remove(added_path)
+            except ValueError:
+                pass
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

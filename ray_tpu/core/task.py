@@ -115,6 +115,8 @@ class TaskSpec:
     max_retries: int = 0
     retry_exceptions: bool = False
     name: Optional[str] = None
+    # normalized runtime env (core/runtime_env.py prepare() output)
+    runtime_env: Optional[Dict[str, Any]] = None
     # actor fields
     actor_id: Optional[str] = None
     method_name: Optional[str] = None

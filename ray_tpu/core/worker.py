@@ -29,6 +29,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import object_store as os_mod
+from ray_tpu.core import runtime_env as runtime_env_mod
 from collections import OrderedDict, deque
 
 from ray_tpu.core.exceptions import (
@@ -786,6 +787,9 @@ class CoreWorker:
             ),
             retry_exceptions=options.retry_exceptions,
             name=options.name or fn_name,
+            runtime_env=runtime_env_mod.prepare(
+                options.runtime_env, self.control
+            ),
         )
         strategy = self._resolve_strategy(options.scheduling_strategy)
         with self._lineage_lock:
@@ -1093,6 +1097,9 @@ class CoreWorker:
             "scheduling_strategy": self._resolve_strategy(
                 actor_options.get("scheduling_strategy")
             ),
+            "runtime_env": runtime_env_mod.prepare(
+                actor_options.get("runtime_env"), self.control
+            ),
             "owner_address": self.address,
         }
         self.control.call("register_actor", spec=spec, retryable=True)
@@ -1277,6 +1284,12 @@ class CoreWorker:
         (actor is DEAD, tell the user why) from "transport failed" (retry
         on another worker)."""
         try:
+            # Actor runtime env applies for the worker's whole life — the
+            # process is dedicated to this actor (reference: worker-pool
+            # processes are keyed by runtime-env hash).
+            runtime_env_mod.apply_permanent(
+                spec.get("runtime_env"), self.control
+            )
             cls = self.load_function(spec["class_id"])
             args, kwargs = serialization.unpack(spec["init_args_frame"])
             args = [self._resolve_arg(a) for a in args]
@@ -1323,7 +1336,8 @@ class CoreWorker:
             args, kwargs = serialization.unpack(spec.args_frame)
             args = [self._resolve_arg(a) for a in args]
             kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
-            result = target(*args, **kwargs)
+            with runtime_env_mod.apply(spec.runtime_env, self.control):
+                result = target(*args, **kwargs)
             returns = self._package_returns(spec, result)
             return {"status": "ok", "returns": returns}
         except TaskError as e:

@@ -1,0 +1,18 @@
+"""ray_tpu.rllib — reinforcement learning (RLlib-lite).
+
+Parity target: the reference RLlib's PPO path at BASELINE config #4's
+shape (CPU env-runner actors + accelerator learner); algorithms beyond
+PPO follow the same EnvRunner/Learner split.
+"""
+
+from ray_tpu.rllib.env import ENV_REGISTRY, CartPole, Env, make_env
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = [
+    "CartPole",
+    "ENV_REGISTRY",
+    "Env",
+    "PPO",
+    "PPOConfig",
+    "make_env",
+]

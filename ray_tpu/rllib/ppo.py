@@ -1,0 +1,299 @@
+"""PPO — the RLlib-lite flagship algorithm.
+
+Parity: the reference Algorithm/EnvRunnerGroup/LearnerGroup split
+(rllib/algorithms/algorithm.py:212, env_runner_group.py:70,
+learner_group.py:100) at BASELINE config #4's shape: CPU env-runner
+ACTORS sample rollouts with a numpy copy of the policy, the LEARNER runs
+the jitted PPO update (clipped surrogate + value loss + entropy bonus
+over GAE advantages) on the driver's accelerator — chips never wait on
+environment stepping, hosts never run SGD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env import make_env
+from ray_tpu.utils import serialization
+
+
+# ---------------------------------------------------------------------------
+# policy: 2-layer MLP -> (logits, value)
+# ---------------------------------------------------------------------------
+
+
+def init_policy(rng, obs_size: int, num_actions: int, hidden: int = 64):
+    import jax
+
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    import jax.numpy as jnp
+
+    def norm(k, shape, scale):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    return {
+        "w1": norm(k1, (obs_size, hidden), 0.5 / obs_size**0.5),
+        "b1": jnp.zeros((hidden,)),
+        "w2": norm(k2, (hidden, hidden), 1.0 / hidden**0.5),
+        "b2": jnp.zeros((hidden,)),
+        "pi": norm(k3, (hidden, num_actions), 0.01),
+        "v": norm(k4, (hidden, 1), 1.0 / hidden**0.5),
+    }
+
+
+def _forward_np(params: Dict[str, np.ndarray], obs: np.ndarray):
+    """Numpy policy forward for the CPU rollout path (no jax import in
+    the hot sampling loop)."""
+    h = np.tanh(obs @ params["w1"] + params["b1"])
+    h = np.tanh(h @ params["w2"] + params["b2"])
+    logits = h @ params["pi"]
+    value = (h @ params["v"])[..., 0]
+    return logits, value
+
+
+def _forward_jnp(params, obs):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(obs @ params["w1"] + params["b1"])
+    h = jnp.tanh(h @ params["w2"] + params["b2"])
+    return h @ params["pi"], (h @ params["v"])[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# env runner actor (CPU sampling)
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class EnvRunner:
+    """Samples rollouts with a numpy snapshot of the policy (parity:
+    SingleAgentEnvRunner)."""
+
+    def __init__(self, env_spec, seed: int):
+        self.env = make_env(env_spec)
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.env.reset(seed=seed)
+        self.episode_return = 0.0
+        self.completed_returns: List[float] = []
+
+    def sample(self, params_blob: bytes, num_steps: int) -> Dict[str, Any]:
+        params = {
+            k: np.asarray(v)
+            for k, v in serialization.unpack(params_blob).items()
+        }
+        obs_buf = np.empty((num_steps, self.env.observation_size), np.float32)
+        act_buf = np.empty((num_steps,), np.int32)
+        logp_buf = np.empty((num_steps,), np.float32)
+        val_buf = np.empty((num_steps,), np.float32)
+        rew_buf = np.empty((num_steps,), np.float32)
+        done_buf = np.empty((num_steps,), np.float32)
+        self.completed_returns = []
+        for t in range(num_steps):
+            logits, value = _forward_np(params, self.obs)
+            z = logits - logits.max()
+            p = np.exp(z)
+            p /= p.sum()
+            action = int(self.rng.choice(len(p), p=p))
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = float(np.log(p[action] + 1e-12))
+            val_buf[t] = float(value)
+            nxt, reward, terminated, truncated, _ = self.env.step(action)
+            rew_buf[t] = reward
+            done = terminated or truncated
+            done_buf[t] = float(done)
+            self.episode_return += reward
+            if done:
+                self.completed_returns.append(self.episode_return)
+                self.episode_return = 0.0
+                nxt, _ = self.env.reset()
+            self.obs = nxt
+        _, last_val = _forward_np(params, self.obs)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_value": float(last_val),
+            "episode_returns": self.completed_returns,
+        }
+
+
+def _gae(batch: Dict[str, np.ndarray], gamma: float, lam: float):
+    """Generalized advantage estimation over one runner's rollout."""
+    rewards, values, dones = batch["rewards"], batch["values"], batch["dones"]
+    T = len(rewards)
+    adv = np.zeros(T, np.float32)
+    last = 0.0
+    next_value = batch["last_value"]
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last = delta + gamma * lam * nonterminal * last
+        adv[t] = last
+        next_value = values[t]
+    return adv, adv + values
+
+
+# ---------------------------------------------------------------------------
+# PPO algorithm
+# ---------------------------------------------------------------------------
+
+
+class PPOConfig:
+    def __init__(
+        self,
+        env: Any = "CartPole-v1",
+        num_env_runners: int = 2,
+        rollout_length: int = 1024,
+        gamma: float = 0.99,
+        gae_lambda: float = 0.95,
+        clip: float = 0.2,
+        lr: float = 1e-3,
+        entropy_coeff: float = 0.01,
+        vf_coeff: float = 0.5,
+        num_epochs: int = 6,
+        minibatch_size: int = 256,
+        hidden: int = 64,
+        seed: int = 0,
+    ):
+        self.env = env
+        self.num_env_runners = num_env_runners
+        self.rollout_length = rollout_length
+        self.gamma = gamma
+        self.gae_lambda = gae_lambda
+        self.clip = clip
+        self.lr = lr
+        self.entropy_coeff = entropy_coeff
+        self.vf_coeff = vf_coeff
+        self.num_epochs = num_epochs
+        self.minibatch_size = minibatch_size
+        self.hidden = hidden
+        self.seed = seed
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    def __init__(self, cfg: PPOConfig):
+        import jax
+        import optax
+
+        self.cfg = cfg
+        probe = make_env(cfg.env)
+        self.params = init_policy(
+            jax.random.PRNGKey(cfg.seed), probe.observation_size,
+            probe.num_actions, cfg.hidden,
+        )
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.runners = [
+            EnvRunner.remote(cfg.env, cfg.seed * 1000 + i)
+            for i in range(cfg.num_env_runners)
+        ]
+        self._train_minibatch = jax.jit(self._make_train_minibatch())
+        self.iteration = 0
+
+    def _make_train_minibatch(self):
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+
+        def loss_fn(params, mb):
+            logits, values = _forward_jnp(params, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=1
+            )[:, 0]
+            ratio = jnp.exp(logp - mb["logp"])
+            adv = mb["adv"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv,
+            )
+            entropy = -jnp.sum(jnp.exp(logp_all) * logp_all, axis=1)
+            vf_loss = jnp.mean((values - mb["targets"]) ** 2)
+            return (
+                -jnp.mean(surr)
+                + cfg.vf_coeff * vf_loss
+                - cfg.entropy_coeff * jnp.mean(entropy)
+            )
+
+        def train_minibatch(params, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss
+
+        return train_minibatch
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: parallel rollouts -> GAE -> minibatch SGD."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        params_np = {k: np.asarray(v) for k, v in self.params.items()}
+        blob = serialization.pack(params_np)
+        batches = ray_tpu.get(
+            [
+                r.sample.remote(blob, cfg.rollout_length)
+                for r in self.runners
+            ],
+            timeout=600,
+        )
+        advs, targets = [], []
+        for b in batches:
+            a, t = _gae(b, cfg.gamma, cfg.gae_lambda)
+            advs.append(a)
+            targets.append(t)
+        data = {
+            "obs": np.concatenate([b["obs"] for b in batches]),
+            "actions": np.concatenate([b["actions"] for b in batches]),
+            "logp": np.concatenate([b["logp"] for b in batches]),
+            "adv": np.concatenate(advs),
+            "targets": np.concatenate(targets),
+        }
+        n = len(data["obs"])
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        losses = []
+        for _ in range(cfg.num_epochs):
+            order = rng.permutation(n)
+            for start in range(0, n, cfg.minibatch_size):
+                idx = order[start:start + cfg.minibatch_size]
+                mb = {k: jnp.asarray(v[idx]) for k, v in data.items()}
+                self.params, self.opt_state, loss = self._train_minibatch(
+                    self.params, self.opt_state, mb
+                )
+                losses.append(float(loss))
+        self.iteration += 1
+        episode_returns = [
+            r for b in batches for r in b["episode_returns"]
+        ]
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (
+                float(np.mean(episode_returns)) if episode_returns else None
+            ),
+            "num_episodes": len(episode_returns),
+            "loss": float(np.mean(losses)),
+            "num_env_steps": n,
+        }
+
+    def get_policy_params(self):
+        return self.params
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        params_np = {k: np.asarray(v) for k, v in self.params.items()}
+        logits, _ = _forward_np(params_np, np.asarray(obs, np.float32))
+        return int(np.argmax(logits))
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass

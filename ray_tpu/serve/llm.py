@@ -1,0 +1,229 @@
+"""Serve LLM — autoregressive model deployments.
+
+Parity: the reference serve.llm stack (python/ray/serve/llm — deployment
++ engine wrapper + OpenAI-ish request shape) with a JAX engine instead of
+vLLM: the replica holds GPT-2 weights, jits one batched decode step, and
+a dynamic micro-batcher (the reference's @serve.batch role) coalesces
+concurrent requests into one padded batched generation so replicas
+saturate the chip instead of decoding one request at a time.
+
+Token-level API (this image has no tokenizer vocab files): requests are
+{"prompt_tokens": [int], "max_new_tokens": N, "temperature": T};
+responses are {"tokens": [int]}. Weights are randomly initialized unless
+a checkpoint path of gpt2.init-compatible arrays is given — the serving
+machinery, not the text quality, is the parity surface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import serve
+
+
+class LLMConfig:
+    def __init__(
+        self,
+        model_id: str = "gpt2-tiny",
+        num_replicas: int = 1,
+        max_batch_size: int = 8,
+        batch_wait_timeout_s: float = 0.02,
+        max_new_tokens_cap: int = 256,
+        checkpoint_path: Optional[str] = None,
+        route_prefix: Optional[str] = "/llm",
+        max_concurrency: int = 16,
+    ):
+        self.model_id = model_id
+        self.num_replicas = num_replicas
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.max_new_tokens_cap = max_new_tokens_cap
+        self.checkpoint_path = checkpoint_path
+        self.route_prefix = route_prefix
+        self.max_concurrency = max_concurrency
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "temperature", "event", "result",
+                 "error")
+
+    def __init__(self, prompt, max_new, temperature):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.event = threading.Event()
+        self.result: Optional[List[int]] = None
+        self.error: Optional[BaseException] = None
+
+
+class LLMServer:
+    """The deployment callable: micro-batched greedy/temperature decode."""
+
+    def __init__(self, config: LLMConfig):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import gpt2
+
+        self.cfg = config
+        self.model_cfg = gpt2.CONFIGS[config.model_id]
+        if config.checkpoint_path:
+            import pickle
+
+            with open(config.checkpoint_path, "rb") as f:
+                self.params = pickle.load(f)
+        else:
+            self.params = gpt2.init(jax.random.PRNGKey(0), self.model_cfg)
+        self._jnp = jnp
+        mcfg = self.model_cfg
+
+        def next_logits(params, tokens, lengths):
+            # tokens [B, T] right-padded; take each row's last real logit
+            logits = gpt2.forward(params, tokens, mcfg)
+            idx = jnp.maximum(lengths - 1, 0)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1
+            )[:, 0, :]
+            return last[:, : mcfg.vocab_size]
+
+        self._next_logits = jax.jit(next_logits)
+        self._rng = jax.random.PRNGKey(1)
+        import collections
+
+        self._queue: List[_Request] = []
+        self._lock = threading.Lock()
+        # bounded: a long-lived replica serves millions of batches
+        self._batch_sizes = collections.deque(maxlen=1000)
+        self._total_batches = 0
+        self._max_batch_seen = 0
+        self._stop = threading.Event()
+        threading.Thread(
+            target=self._batch_loop, name="llm-batcher", daemon=True
+        ).start()
+
+    # -- request path ---------------------------------------------------
+
+    def __call__(self, request: Any) -> Dict[str, Any]:
+        if hasattr(request, "json"):  # HTTP proxy path
+            request = request.json()
+        prompt = list(request.get("prompt_tokens") or [0])
+        max_new = min(
+            int(request.get("max_new_tokens", 16)),
+            self.cfg.max_new_tokens_cap,
+        )
+        temperature = float(request.get("temperature", 0.0))
+        req = _Request(prompt, max_new, temperature)
+        with self._lock:
+            self._queue.append(req)
+        if not req.event.wait(timeout=300):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return {"tokens": req.result}
+
+    def batch_stats(self, _payload=None) -> Dict[str, Any]:
+        with self._lock:
+            sizes = list(self._batch_sizes)
+            total = self._total_batches
+            mx = self._max_batch_seen
+        return {
+            "batches": total,
+            "max_batch": mx,
+            "mean_batch": sum(sizes) / len(sizes) if sizes else 0,
+        }
+
+    # -- batcher --------------------------------------------------------
+
+    def _take_batch(self) -> List[_Request]:
+        deadline = time.monotonic() + self.cfg.batch_wait_timeout_s
+        while not self._stop.is_set():
+            with self._lock:
+                if len(self._queue) >= self.cfg.max_batch_size or (
+                    self._queue and time.monotonic() >= deadline
+                ):
+                    batch = self._queue[: self.cfg.max_batch_size]
+                    del self._queue[: len(batch)]
+                    return batch
+                if not self._queue:
+                    deadline = time.monotonic() + self.cfg.batch_wait_timeout_s
+            time.sleep(0.002)
+        return []
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self._take_batch()
+            if not batch:
+                continue
+            try:
+                self._generate(batch)
+            except Exception as e:  # noqa: BLE001
+                # fail THIS batch's callers with the error and keep the
+                # batcher alive — one poisoned request must not turn the
+                # replica into a black hole
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+
+    def _generate(self, batch: List[_Request]) -> None:
+        import jax
+        import numpy as np
+
+        jnp = self._jnp
+        with self._lock:
+            self._batch_sizes.append(len(batch))
+            self._total_batches += 1
+            self._max_batch_seen = max(self._max_batch_seen, len(batch))
+        B = len(batch)
+        max_new = max(r.max_new for r in batch)
+        max_prompt = max(len(r.prompt) for r in batch)
+        total = min(max_prompt + max_new, self.model_cfg.n_positions)
+        tokens = np.zeros((B, total), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        for i, r in enumerate(batch):
+            p = r.prompt[-self.model_cfg.n_positions:]
+            tokens[i, : len(p)] = p
+            lengths[i] = len(p)
+        tokens = jnp.asarray(tokens)
+        lengths = jnp.asarray(lengths)
+        outs: List[List[int]] = [[] for _ in range(B)]
+        for _ in range(max_new):
+            logits = self._next_logits(self.params, tokens, lengths)
+            greedy = jnp.argmax(logits, axis=-1)
+            self._rng, sub = jax.random.split(self._rng)
+            temps = jnp.asarray(
+                [max(r.temperature, 1e-6) for r in batch], jnp.float32
+            )
+            sampled = jax.random.categorical(sub, logits / temps[:, None])
+            use_greedy = jnp.asarray(
+                [r.temperature <= 0 for r in batch]
+            )
+            nxt = jnp.where(use_greedy, greedy, sampled).astype(jnp.int32)
+            nxt_np = np.asarray(nxt)
+            len_np = np.asarray(lengths)
+            for i, r in enumerate(batch):
+                if len(outs[i]) < r.max_new and len_np[i] < total:
+                    outs[i].append(int(nxt_np[i]))
+            # append in place where there is room
+            can = lengths < total
+            tokens = tokens.at[jnp.arange(B), jnp.minimum(lengths, total - 1)].set(
+                jnp.where(can, nxt, tokens[jnp.arange(B), total - 1])
+            )
+            lengths = jnp.minimum(lengths + 1, total)
+        for i, r in enumerate(batch):
+            r.result = outs[i][: r.max_new]
+            r.event.set()
+
+
+def build_llm_deployment(config: Optional[LLMConfig] = None) -> Any:
+    """Deployment for an LLM server (parity: serve.llm build_llm_deployment)."""
+    config = config or LLMConfig()
+    dep = serve.deployment(
+        LLMServer,
+        name=f"llm-{config.model_id}",
+        num_replicas=config.num_replicas,
+        route_prefix=config.route_prefix,
+        max_concurrency=config.max_concurrency,
+    )
+    return dep.bind(config)

@@ -118,6 +118,14 @@ config.define("borrow_pin_ttl_s", 600.0)
 # Owner-side lineage entries kept for object reconstruction (reference
 # bounds lineage by bytes; we bound by task count).
 config.define("lineage_max_entries", 10000)
+# Memory monitor (reference C19): kill a worker when host memory usage
+# crosses the threshold. testing_memory_usage >= 0 injects a fake reading.
+config.define("memory_usage_threshold", 0.95)
+config.define("memory_monitor_period_s", 1.0)
+config.define("testing_memory_usage", -1.0)
+# Control-store metadata persistence (reference C14 Redis FT mode):
+# empty = in-memory only; a path enables snapshot/restore across restarts.
+config.define("control_store_persistence_path", "")
 config.define("lineage_max_bytes", 256 * 1024 * 1024)
 config.define("actor_max_restarts", 0)
 config.define("log_to_driver", True)

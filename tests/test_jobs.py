@@ -1,0 +1,63 @@
+"""Job submission tests (parity model: reference ray job SDK tests)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    ray_tpu.init(num_cpus=4)
+    yield JobSubmissionClient()
+    ray_tpu.shutdown()
+
+
+def test_job_succeeds_with_logs(client):
+    sid = client.submit_job(
+        entrypoint="python -c \"print('hello from job'); print(6*7)\"",
+    )
+    status = client.wait_until_finished(sid, timeout_s=120)
+    assert status == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(sid)
+    assert "hello from job" in logs and "42" in logs
+    info = client.get_job_info(sid)
+    assert info["returncode"] == 0
+
+
+def test_job_joins_cluster(client):
+    """The submitted script connects back to THIS cluster via RT_ADDRESS
+    and runs a task on it."""
+    script = (
+        "import os, ray_tpu; "
+        "ray_tpu.init(address=os.environ['RT_ADDRESS']); "
+        "f = ray_tpu.remote(lambda: 'in-cluster'); "
+        "print(ray_tpu.get(f.remote()))"
+    )
+    sid = client.submit_job(entrypoint=f'python -c "{script}"')
+    assert client.wait_until_finished(sid, timeout_s=180) == JobStatus.SUCCEEDED
+    assert "in-cluster" in client.get_job_logs(sid)
+
+
+def test_job_failure_reported(client):
+    sid = client.submit_job(entrypoint="python -c \"raise SystemExit(3)\"")
+    assert client.wait_until_finished(sid, timeout_s=120) == JobStatus.FAILED
+    assert client.get_job_info(sid)["returncode"] == 3
+
+
+def test_job_stop(client):
+    sid = client.submit_job(entrypoint="python -c \"import time; time.sleep(600)\"")
+    deadline = time.monotonic() + 60
+    while client.get_job_status(sid) != JobStatus.RUNNING:
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    assert client.stop_job(sid)
+    assert client.wait_until_finished(sid, timeout_s=60) == JobStatus.STOPPED
+
+
+def test_job_list(client):
+    jobs = client.list_jobs()
+    assert len(jobs) >= 4
+    assert all("submission_id" in j for j in jobs)

@@ -1,0 +1,41 @@
+"""Memory monitor / OOM killer test (reference C19: MemoryMonitor +
+WorkerKillingPolicy)."""
+
+import pytest
+
+import ray_tpu
+
+
+def test_memory_monitor_kills_workers():
+    """With an injected 100% memory reading, the agent's OOM killer
+    terminates leased workers; the task fails with a worker-crash error
+    instead of taking the node down."""
+    from ray_tpu.core.cluster_utils import Cluster
+    from ray_tpu.utils.config import config
+
+    c = Cluster()
+    try:
+        config.set("testing_memory_usage", 1.0)
+        config.set("memory_monitor_period_s", 0.2)
+        c.add_node(num_cpus=2)
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote(max_retries=0)
+        def hog():
+            import time
+
+            time.sleep(30)
+            return "survived"
+
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(hog.remote(), timeout=60)
+        assert "worker" in str(ei.value).lower() or "died" in str(
+            ei.value
+        ).lower()
+    finally:
+        config.set("testing_memory_usage", -1.0)
+        config.set("memory_monitor_period_s", 1.0)
+        try:
+            ray_tpu.shutdown()
+        finally:
+            c.shutdown()

@@ -1,0 +1,32 @@
+"""Control-store persistence test (reference C14: pluggable metadata
+storage — Redis FT mode equivalent, file-backed here)."""
+
+
+def test_control_store_snapshot_restore(tmp_path):
+    from ray_tpu.core.control_store import ControlStore
+    from ray_tpu.utils.rpc import RpcClient
+
+    path = str(tmp_path / "gcs.snap")
+    cs = ControlStore("sess1" + "0" * 26, persistence_path=path)
+    cs.start()
+    try:
+        client = RpcClient(cs.address, name="t")
+        client.call("kv_put", ns="fn", key="abc", value=b"blob-1")
+        client.call("kv_put", ns="meta", key="k", value=b"v")
+        job_id = client.call("register_job", driver_address="d:1", metadata={})
+        client.close()
+    finally:
+        cs.stop()
+
+    # a NEW control store on the same path restores the metadata
+    cs2 = ControlStore("sess2" + "0" * 26, persistence_path=path)
+    cs2.start()
+    try:
+        client = RpcClient(cs2.address, name="t2")
+        assert client.call("kv_get", ns="fn", key="abc") == b"blob-1"
+        assert client.call("kv_get", ns="meta", key="k") == b"v"
+        jobs = client.call("list_jobs")
+        assert any(j["job_id"] == job_id for j in jobs)
+        client.close()
+    finally:
+        cs2.stop()

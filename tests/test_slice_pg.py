@@ -1,0 +1,70 @@
+"""SlicePlacementGroup tests (parity model: reference ray.util.tpu slice
+gang scheduling, python/ray/tests on tpu pod scheduling)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+
+
+@pytest.fixture
+def tpu_cluster():
+    """4 fake TPU hosts forming one v5litepod-16 slice (4 chips each;
+    host 0 carries the slice-head resource, as worker 0 would)."""
+    c = Cluster()
+    try:
+        c.add_node(num_cpus=1, num_tpus=4,
+                   resources={"TPU-v5litepod-16-head": 1})
+        for _ in range(3):
+            c.add_node(num_cpus=1, num_tpus=4)
+        ray_tpu.init(address=c.address)
+        yield c
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            c.shutdown()
+
+
+def test_slice_reserved_as_gang(tpu_cluster):
+    from ray_tpu.accelerators import slice_placement_group
+
+    spg = slice_placement_group("v5litepod-16", chips_per_host=4)
+    assert spg.num_workers_per_slice == 4
+    assert spg.wait(60), "slice not schedulable"
+    locs = spg.placement_group.table()["bundle_locations"]
+    # one bundle per host, all four hosts used
+    assert len(set(locs.values())) == 4
+    # bundle 0 (the head bundle) landed on the head-resource node
+    head_node = next(
+        n for n in tpu_cluster.nodes
+        if n.node_id == locs[0]
+    )
+    assert head_node is not None
+
+    # a worker actor pinned to each slice host via the bundle strategy
+    @ray_tpu.remote(num_cpus=0, num_tpus=4)
+    class SliceWorker:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    workers = [
+        SliceWorker.options(
+            scheduling_strategy=spg.worker_strategy(0, i)
+        ).remote()
+        for i in range(4)
+    ]
+    nodes = ray_tpu.get([w.node.remote() for w in workers], timeout=120)
+    assert sorted(nodes) == sorted(locs[i] for i in range(4))
+    env = spg.coordinator_env("10.0.0.1:8081", slice_id=0)
+    assert env["MEGASCALE_NUM_SLICES"] == "1"
+    spg.remove()
+
+
+def test_slice_infeasible_without_head(tpu_cluster):
+    from ray_tpu.accelerators import slice_placement_group
+
+    # no node advertises a v9-head resource -> stays pending
+    spg = slice_placement_group("v9pod-16", chips_per_host=4)
+    assert not spg.wait(1.5)
+    spg.remove()
