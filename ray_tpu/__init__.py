@@ -18,7 +18,9 @@ from ray_tpu._version import __version__
 # Core public API (lazily bound to keep `import ray_tpu` light — no JAX
 # import unless a JAX-facing subpackage is used).
 from ray_tpu.core.api import (
+    available_resources,
     cancel,
+    cluster_resources,
     get,
     get_actor,
     get_runtime_context,
@@ -26,6 +28,7 @@ from ray_tpu.core.api import (
     is_initialized,
     kill,
     method,
+    nodes,
     put,
     remote,
     shutdown,
@@ -61,6 +64,9 @@ __all__ = [
     "__version__",
     "ObjectRef",
     "PlacementGroup",
+    "available_resources",
+    "cluster_resources",
+    "nodes",
     "cancel",
     "exceptions",
     "get",

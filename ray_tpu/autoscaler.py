@@ -1,0 +1,209 @@
+"""Autoscaler — demand-driven node provisioning.
+
+Parity: the reference autoscaler v2 (python/ray/autoscaler/v2/
+autoscaler.py:50 — read cluster state, bin-pack pending demand,
+reconcile instances through a NodeProvider). Demand here is the
+pending-lease count each agent reports on its heartbeat (the role the
+reference's resource_load syncer data plays); the provider abstraction
+keeps cloud/k8s TPU-pod providers pluggable, with LocalNodeProvider
+(subprocess node agents, the Cluster harness's mechanism) as the
+in-repo implementation used by tests and single-host elasticity.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.utils.config import config
+from ray_tpu.utils.rpc import RpcClient, RpcError
+
+logger = logging.getLogger(__name__)
+
+
+class NodeProvider:
+    """Pluggable node lifecycle (reference: autoscaler node providers)."""
+
+    def create_node(self) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns node agents as local processes (single-host elasticity and
+    the test tier; a cloud provider would call instance APIs instead)."""
+
+    def __init__(self, control_address: str, session_id: str,
+                 resources: Optional[Dict[str, float]] = None):
+        self.control_address = control_address
+        self.session_id = session_id
+        self.resources = dict(resources or {"CPU": 1.0})
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def create_node(self) -> str:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RT_CONFIG_SNAPSHOT"] = config.snapshot()
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.core.node_main",
+                "--control-address", self.control_address,
+                "--session-id", self.session_id,
+                "--resources", json.dumps(self.resources),
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=None,
+            start_new_session=True,
+        )
+        # a hung spawn must not wedge the reconcile thread forever
+        import selectors
+
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
+        try:
+            if not sel.select(timeout=60.0):
+                proc.kill()
+                raise RuntimeError("node spawn produced no startup line in 60s")
+        finally:
+            sel.close()
+        line = proc.stdout.readline().decode().strip()
+        info = json.loads(line)
+        self._procs[info["node_id"]] = proc
+        logger.info("autoscaler launched node %s", info["node_id"][:8])
+        return info["node_id"]
+
+    def terminate_node(self, node_id: str) -> None:
+        proc = self._procs.pop(node_id, None)
+        if proc is None:
+            return
+        try:
+            os.killpg(os.getpgid(proc.pid), 15)
+        except (ProcessLookupError, PermissionError):
+            proc.terminate()
+        logger.info("autoscaler terminated node %s", node_id[:8])
+
+    def shutdown(self) -> None:
+        for nid in list(self._procs):
+            self.terminate_node(nid)
+
+
+class Autoscaler:
+    """Scale up while any node reports pending leases; scale an idle
+    autoscaler-launched node down after idle_timeout_s."""
+
+    def __init__(
+        self,
+        control_address: str,
+        provider: NodeProvider,
+        min_nodes: int = 1,
+        max_nodes: int = 4,
+        idle_timeout_s: float = 30.0,
+        poll_period_s: float = 1.0,
+        upscale_cooldown_s: float = 3.0,
+    ):
+        self.control_address = control_address
+        self.provider = provider
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.idle_timeout_s = idle_timeout_s
+        self.poll_period_s = poll_period_s
+        self.upscale_cooldown_s = upscale_cooldown_s
+        self._launched: List[str] = []  # node_ids we created (LIFO down-scale)
+        self._idle_since: Dict[str, float] = {}
+        self._last_upscale = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self.provider.shutdown()
+
+    def _loop(self) -> None:
+        client = RpcClient(self.control_address, name="autoscaler")
+        try:
+            while not self._stop.wait(self.poll_period_s):
+                try:
+                    self._step(client)
+                except Exception:  # noqa: BLE001 — keep reconciling
+                    logger.exception("autoscaler step failed")
+        finally:
+            client.close()
+
+    def _step(self, client: RpcClient) -> None:
+        try:
+            nodes = client.call("get_nodes", alive_only=True, timeout_s=10.0)
+        except RpcError:
+            return
+        n_alive = len(nodes)
+        demand = sum(int(n.get("pending_leases", 0)) for n in nodes)
+        now = time.monotonic()
+        if (
+            demand > 0
+            and n_alive < self.max_nodes
+            and now - self._last_upscale >= self.upscale_cooldown_s
+        ):
+            self._last_upscale = now
+            node_id = self.provider.create_node()
+            self._launched.append(node_id)
+            return
+        # scale down: only nodes WE launched, newest first, when the whole
+        # cluster has no demand and the node itself is idle
+        alive_ids = {n["node_id"] for n in nodes}
+        busy_ids = {
+            n["node_id"] for n in nodes
+            if n.get("active_leases", 0) or n.get("pending_leases", 0)
+        }
+        for nid in list(self._launched):
+            if nid not in alive_ids:
+                self._launched.remove(nid)
+                self._idle_since.pop(nid, None)
+                continue
+            if demand > 0 or nid in busy_ids or n_alive <= self.min_nodes:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            if now - first >= self.idle_timeout_s:
+                # heartbeat lease counts can be up to a period stale: ask
+                # the agent DIRECTLY before killing, so a just-granted
+                # lease is never torn down (two-phase drain)
+                addr = next(
+                    (n["address"] for n in nodes if n["node_id"] == nid), None
+                )
+                if addr:
+                    probe = RpcClient(addr, name="autoscaler-probe")
+                    try:
+                        st = probe.call("get_state", timeout_s=5.0)
+                        if st.get("leases"):
+                            self._idle_since[nid] = now  # busy after all
+                            continue
+                    except RpcError:
+                        pass  # unreachable: fall through and reap it
+                    finally:
+                        probe.close()
+                try:
+                    client.call("drain_node", node_id=nid, timeout_s=10.0)
+                except RpcError:
+                    pass
+                self.provider.terminate_node(nid)
+                self._launched.remove(nid)
+                self._idle_since.pop(nid, None)
+                n_alive -= 1

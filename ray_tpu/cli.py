@@ -49,6 +49,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     tl = sub.add_parser("timeline", help="dump a Chrome-trace timeline")
     tl.add_argument("--out", default="timeline.json")
     sub.add_parser("metrics", help="aggregated user metrics (Prometheus text)")
+    dash = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    dash.add_argument("--port", type=int, default=8265)
     job = sub.add_parser("job", help="submit / inspect cluster jobs")
     jobsub = job.add_subparsers(dest="job_cmd", required=True)
     js = jobsub.add_parser("submit")
@@ -117,6 +119,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ray_tpu.utils import metrics as metrics_mod
 
         print(metrics_mod.prometheus_text(state.cluster_metrics(addr)), end="")
+        return 0
+    if args.cmd == "dashboard":
+        import time as _time
+
+        from ray_tpu.dashboard import Dashboard
+
+        if not addr:
+            print("--address (or $RT_ADDRESS) required", file=sys.stderr)
+            return 2
+        d = Dashboard(addr, port=args.port)
+        d.start()
+        print(f"dashboard serving on http://{d.address} (ctrl-c to stop)")
+        try:
+            while True:
+                _time.sleep(3600)
+        except KeyboardInterrupt:
+            d.stop()
         return 0
     if args.cmd == "job":
         from ray_tpu.job_submission import JobSubmissionClient

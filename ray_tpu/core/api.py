@@ -110,6 +110,9 @@ def init(
         w.namespace = namespace
         w.connect_driver()
         worker_mod.set_global_worker(w)
+        from ray_tpu import usage
+
+        usage.record("init", mode="head" if address is None else "client")
         return RuntimeContext(w)
 
 

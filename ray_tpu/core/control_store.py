@@ -266,13 +266,16 @@ class ControlStore:
         return {"config_snapshot": config.snapshot(), "session_id": self.session_id}
 
     def rpc_heartbeat(self, conn, node_id: str, resources_available: Dict[str, float],
-                      extra: Optional[Dict[str, Any]] = None):
+                      extra: Optional[Dict[str, Any]] = None,
+                      pending_leases: int = 0, active_leases: int = 0):
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node["alive"]:
                 return {"ok": False}  # tells a zombie agent to exit
             node["last_heartbeat"] = time.monotonic()
             node["resources_available"] = resources_available
+            node["pending_leases"] = pending_leases
+            node["active_leases"] = active_leases
             if extra:
                 node.update(extra)
         return {"ok": True}
@@ -302,6 +305,8 @@ class ControlStore:
             "resources_total": n["resources_total"],
             "labels": n.get("labels", {}),
             "alive": n["alive"],
+            "pending_leases": n.get("pending_leases", 0),
+            "active_leases": n.get("active_leases", 0),
         }
 
     def _health_loop(self) -> None:

@@ -90,6 +90,10 @@ class NodeAgent:
         self._workers: Dict[str, _Worker] = {}  # worker_id hex -> record
         self._leases: Dict[str, Dict[str, Any]] = {}  # lease_id -> info
         self._pending_spawns = 0
+        # lease requests currently waiting for resources (the autoscaler's
+        # demand signal, carried on heartbeats — reference: resource_load
+        # in the syncer's node snapshots)
+        self._pending_leases = 0
 
         self.temp_dir = temp_dir or os.path.join(
             config.temp_dir, f"session_{session_id[:8]}"
@@ -157,10 +161,13 @@ class NodeAgent:
         while not self._stopped.wait(config.health_check_period_s):
             with self._lock:
                 avail = dict(self.resources_available)
+                pending = self._pending_leases
+                busy = len(self._leases)
             try:
                 reply = self._control.call(
                     "heartbeat", node_id=self.node_id.hex(),
                     resources_available=avail, timeout_s=5.0,
+                    pending_leases=pending, active_leases=busy,
                 )
                 if not reply.get("ok"):
                     # Declared dead by the control plane: our actors may
@@ -367,10 +374,22 @@ class NodeAgent:
                     return {"granted": False, "error": "bundle not found"}
         deadline = time.monotonic() + wait_s
         kind = "tpu" if resources.get("TPU") else "cpu"
+        return self._lease_wait(resources, bundle, deadline, kind, strategy)
+
+    def _lease_wait(self, resources, bundle, deadline, kind, strategy=None):
         spawned_for_me = False
-        with self._lock:
+        starved = False  # counted toward autoscaler demand
+        last_spill_check = time.monotonic()
+        self._lock.acquire()
+        try:
             while True:
                 ok, resolved_bundle = self._try_allocate_locked(resources, bundle)
+                if not ok and bundle is None and not starved:
+                    # Resource-starved (NOT merely waiting on a worker
+                    # spawn, and not bundle-pinned — a new node can't
+                    # serve those): the autoscaler's demand signal.
+                    starved = True
+                    self._pending_leases += 1
                 if ok:
                     worker = self._pop_idle_worker_locked(kind)
                     if worker is not None:
@@ -401,7 +420,56 @@ class NodeAgent:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return {"granted": False, "error": "lease timeout"}
+                # A queued lease must notice capacity that appears
+                # ELSEWHERE (an autoscaler-launched node): periodically
+                # re-consult the cluster view — WITH the original strategy
+                # (hard affinity must not be hijacked) — and spill only to
+                # a node that actually has the resources AVAILABLE (a
+                # feasible-by-total-but-full node would just bounce the
+                # lease back and forth until the hop cap kills the task).
+                if (
+                    not ok
+                    and bundle is None
+                    and time.monotonic() - last_spill_check > 1.0
+                ):
+                    last_spill_check = time.monotonic()
+                    self._lock.release()
+                    try:
+                        target = self._pick_available_target(
+                            resources, strategy
+                        )
+                    finally:
+                        self._lock.acquire()
+                    if (
+                        target is not None
+                        and target["node_id"] != self.node_id.hex()
+                    ):
+                        return {
+                            "granted": False,
+                            "spillback": target["address"],
+                        }
                 self._cv.wait(min(remaining, 0.5))
+        finally:
+            if starved:
+                self._pending_leases -= 1
+            self._lock.release()
+
+    def _pick_available_target(self, resources, strategy):
+        """Like _pick_target_node, but only returns nodes whose AVAILABLE
+        resources fit the request (used by the mid-wait re-spill)."""
+        try:
+            view = self._control.call("get_cluster_view", timeout_s=5.0)
+        except RpcError:
+            return None
+        node_id = scheduling.pick_node(
+            view, resources, strategy, local_node_id=self.node_id.hex()
+        )
+        if node_id is None or node_id not in view:
+            return None
+        avail = view[node_id].get("resources_available", {})
+        if not all(avail.get(k, 0.0) >= v for k, v in resources.items() if v > 0):
+            return None
+        return {"node_id": node_id, "address": view[node_id]["address"]}
 
     def rpc_release_worker(self, conn, lease_id: str, kill: bool = False):
         with self._lock:

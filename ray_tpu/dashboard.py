@@ -1,0 +1,149 @@
+"""Dashboard — HTTP view of the cluster.
+
+Parity: the reference dashboard head (python/ray/dashboard/head.py) at
+its observability core: JSON APIs over the state aggregator plus a
+self-refreshing HTML summary. Heavy UI, per-node agents, and Grafana
+provisioning are out of scope — the state API (state.py) carries the
+same data to programmatic consumers.
+
+Endpoints: /           HTML summary (auto-refresh)
+           /api/status /api/nodes /api/actors /api/jobs /api/workers
+           /api/placement_groups /api/timeline /metrics (Prometheus text)
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ray_tpu import state
+from ray_tpu.utils import metrics as metrics_mod
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; margin: 1em 0; }}
+ td, th {{ border: 1px solid #999; padding: 4px 10px; text-align: left; }}
+ h2 {{ margin-top: 1.5em; }}
+</style></head><body>
+<h1>ray_tpu cluster</h1>
+<pre>{status}</pre>
+<h2>nodes</h2>{nodes}
+<h2>actors</h2>{actors}
+<h2>jobs</h2>{jobs}
+<p>APIs: /api/status /api/nodes /api/actors /api/jobs /api/workers
+/api/placement_groups /api/timeline /metrics</p>
+</body></html>"""
+
+
+def _table(rows, columns) -> str:
+    head = "".join(f"<th>{html.escape(c)}</th>" for c in columns)
+    body = "".join(
+        "<tr>" + "".join(
+            f"<td>{html.escape(str(r.get(c, '')))}</td>" for c in columns
+        ) + "</tr>"
+        for r in rows
+    )
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+class Dashboard:
+    def __init__(self, control_address: str, host: str = "0.0.0.0",
+                 port: int = 0):
+        self.control_address = control_address
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    status, ctype, body = dash._route(self.path)
+                except Exception as e:  # noqa: BLE001
+                    status, ctype = 500, "application/json"
+                    body = json.dumps({"error": str(e)}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        if host in ("0.0.0.0", "::"):  # wildcard bind isn't connectable
+            host = "127.0.0.1"
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dashboard", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------------
+
+    def _route(self, path: str):
+        addr = self.control_address
+        apis = {
+            "/api/status": lambda: state.cluster_status(addr),
+            "/api/nodes": lambda: state.list_nodes(addr),
+            "/api/actors": lambda: state.list_actors(addr),
+            "/api/jobs": lambda: state.list_jobs(addr),
+            "/api/workers": lambda: state.list_workers(addr),
+            "/api/placement_groups": lambda: state.list_placement_groups(addr),
+            "/api/timeline": lambda: state.timeline(addr),
+        }
+        if path in apis:
+            return (
+                200, "application/json",
+                json.dumps(apis[path](), default=str).encode(),
+            )
+        if path == "/metrics":
+            text = metrics_mod.prometheus_text(state.cluster_metrics(addr))
+            return 200, "text/plain; version=0.0.4", text.encode()
+        if path in ("/", "/index.html"):
+            st = state.cluster_status(addr)
+            page = _PAGE.format(
+                status=html.escape(json.dumps(st, indent=2)),
+                nodes=_table(
+                    state.list_nodes(addr),
+                    ["node_id", "address", "alive", "active_leases",
+                     "pending_leases"],
+                ),
+                actors=_table(
+                    state.list_actors(addr),
+                    ["actor_id", "class_name", "state", "name"],
+                ),
+                jobs=_table(
+                    state.list_jobs(addr), ["job_id", "alive"],
+                ),
+            )
+            return 200, "text/html", page.encode()
+        return 404, "application/json", b'{"error": "not found"}'
+
+
+def start_dashboard(control_address: Optional[str] = None,
+                    port: int = 0) -> Dashboard:
+    if control_address is None:
+        from ray_tpu.core import worker as worker_mod
+
+        control_address = worker_mod.global_worker().control_address
+    dash = Dashboard(control_address, port=port)
+    dash.start()
+    return dash

@@ -15,10 +15,15 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.utils.rpc import RpcClient, RpcError
+from ray_tpu.utils.rpc import ClientPool, RpcError
+
+# Pooled connections: the dashboard's 5s auto-refresh page renders several
+# state calls per view — dialing and closing a fresh socket per call would
+# hammer the control store.
+_pool = ClientPool("state-api")
 
 
-def _control(address: Optional[str]) -> RpcClient:
+def _control(address: Optional[str]):
     if address is None:
         from ray_tpu.core import worker as worker_mod
 
@@ -28,15 +33,11 @@ def _control(address: Optional[str]) -> RpcClient:
                 "not connected: pass address= or call ray_tpu.init() first"
             )
         address = w.control_address
-    return RpcClient(address, name="state-api")
+    return _pool.get(address)
 
 
 def _with_control(address, fn):
-    client = _control(address)
-    try:
-        return fn(client)
-    finally:
-        client.close()
+    return fn(_control(address))
 
 
 def list_nodes(address: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -61,13 +62,12 @@ def _agent_states(address: Optional[str]) -> List[Dict[str, Any]]:
     nodes = [n for n in list_nodes(address) if n.get("alive", True)]
     out = []
     for n in nodes:
-        client = RpcClient(n["address"], name="state-api-agent")
         try:
-            out.append(client.call("get_state", timeout_s=10.0))
+            out.append(
+                _pool.get(n["address"]).call("get_state", timeout_s=10.0)
+            )
         except RpcError:
-            pass
-        finally:
-            client.close()
+            _pool.drop(n["address"])
     return out
 
 
@@ -136,13 +136,12 @@ def task_events(address: Optional[str] = None) -> List[Dict[str, Any]]:
     """Collect task execution events from every live worker."""
     events: List[Dict[str, Any]] = []
     for addr in _worker_addresses(address):
-        client = RpcClient(addr, name="state-api-worker")
         try:
-            events.extend(client.call("get_task_events", timeout_s=10.0))
+            events.extend(
+                _pool.get(addr).call("get_task_events", timeout_s=10.0)
+            )
         except RpcError:
-            pass
-        finally:
-            client.close()
+            _pool.drop(addr)
     return events
 
 
@@ -176,13 +175,11 @@ def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:
     counters/histograms sum, gauges keep the latest per series."""
     merged: Dict[str, Dict] = {}
     for addr in _worker_addresses(address):
-        client = RpcClient(addr, name="state-api-metrics")
         try:
-            snap = client.call("get_metrics", timeout_s=10.0)
+            snap = _pool.get(addr).call("get_metrics", timeout_s=10.0)
         except RpcError:
+            _pool.drop(addr)
             continue
-        finally:
-            client.close()
         for name, m in snap.items():
             cur = merged.get(name)
             if cur is None:

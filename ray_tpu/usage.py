@@ -1,0 +1,45 @@
+"""Usage stats — opt-out local usage recording.
+
+Parity: the reference's usage-stats subsystem (python/ray/_private/usage
+— P17) without any network reporting: this environment has no egress, so
+stats are recorded to a local JSON file for the operator's own
+inspection. Disable entirely with RT_USAGE_STATS_ENABLED=0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict
+
+def _path() -> str:
+    from ray_tpu.utils.config import config
+
+    return os.path.join(str(config.temp_dir), "usage_stats.json")
+
+
+def enabled() -> bool:
+    return os.environ.get("RT_USAGE_STATS_ENABLED", "1") not in ("0", "false")
+
+
+def record(event: str, **fields: Any) -> None:
+    """Append one usage event (best-effort; never raises)."""
+    if not enabled():
+        return
+    try:
+        path = _path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        entry: Dict[str, Any] = {"event": event, "ts": time.time(), **fields}
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError:
+        pass
+
+
+def read_all():
+    try:
+        with open(_path()) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except (OSError, ValueError):
+        return []

@@ -115,3 +115,45 @@ def test_cli_smoke(rt, tmp_path, capsys):
     capsys.readouterr()
     json.load(open(tl))
     assert main(["--address", addr, "metrics"]) == 0
+
+
+def test_dashboard_endpoints(rt):
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def tiny():
+        return 1
+
+    assert ray_tpu.get(tiny.remote()) == 1
+    dash = start_dashboard(port=0)
+    try:
+        host_port = dash.address.replace("0.0.0.0", "127.0.0.1")
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                f"http://{host_port}{path}", timeout=30
+            ) as resp:
+                return resp.status, resp.read()
+
+        status, body = fetch("/api/status")
+        assert status == 200
+        st = json.loads(body)
+        assert st["nodes_alive"] >= 1
+        status, body = fetch("/api/nodes")
+        assert status == 200 and json.loads(body)
+        status, body = fetch("/api/timeline")
+        assert status == 200
+        assert any(e["name"] == "tiny" for e in json.loads(body))
+        status, body = fetch("/")
+        assert status == 200 and b"ray_tpu cluster" in body
+        status, body = fetch("/metrics")
+        assert status == 200
+        try:
+            fetch("/nope")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        dash.stop()
