@@ -52,33 +52,11 @@ class LocalNodeProvider(NodeProvider):
         self._procs: Dict[str, subprocess.Popen] = {}
 
     def create_node(self) -> str:
-        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        env["RT_CONFIG_SNAPSHOT"] = config.snapshot()
-        proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "ray_tpu.core.node_main",
-                "--control-address", self.control_address,
-                "--session-id", self.session_id,
-                "--resources", json.dumps(self.resources),
-            ],
-            env=env, stdout=subprocess.PIPE, stderr=None,
-            start_new_session=True,
-        )
-        # a hung spawn must not wedge the reconcile thread forever
-        import selectors
+        from ray_tpu.core.cluster_utils import spawn_node_agent
 
-        sel = selectors.DefaultSelector()
-        sel.register(proc.stdout, selectors.EVENT_READ)
-        try:
-            if not sel.select(timeout=60.0):
-                proc.kill()
-                raise RuntimeError("node spawn produced no startup line in 60s")
-        finally:
-            sel.close()
-        line = proc.stdout.readline().decode().strip()
-        info = json.loads(line)
+        proc, info = spawn_node_agent(
+            self.control_address, self.session_id, self.resources
+        )
         self._procs[info["node_id"]] = proc
         logger.info("autoscaler launched node %s", info["node_id"][:8])
         return info["node_id"]

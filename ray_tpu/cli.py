@@ -51,6 +51,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("metrics", help="aggregated user metrics (Prometheus text)")
     dash = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     dash.add_argument("--port", type=int, default=8265)
+    dash.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind host (default loopback; the APIs are unauthenticated)",
+    )
     job = sub.add_parser("job", help="submit / inspect cluster jobs")
     jobsub = job.add_subparsers(dest="job_cmd", required=True)
     js = jobsub.add_parser("submit")
@@ -128,7 +132,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not addr:
             print("--address (or $RT_ADDRESS) required", file=sys.stderr)
             return 2
-        d = Dashboard(addr, port=args.port)
+        d = Dashboard(addr, host=args.host, port=args.port)
         d.start()
         print(f"dashboard serving on http://{d.address} (ctrl-c to stop)")
         try:

@@ -21,6 +21,69 @@ from ray_tpu.utils.config import config
 from ray_tpu.utils.rpc import RpcClient
 
 
+def spawn_node_agent(
+    control_address: str,
+    session_id: str,
+    resources: Dict[str, float],
+    labels: Optional[Dict[str, str]] = None,
+    startup_timeout_s: float = 60.0,
+):
+    """Start a node agent process and wait for its one-line JSON startup
+    handshake. Shared by the test Cluster and the autoscaler's
+    LocalNodeProvider — the spawn protocol must not fork."""
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["RT_CONFIG_SNAPSHOT"] = config.snapshot()
+    # stderr goes to a FILE, not a pipe: nothing drains node logs for the
+    # process's lifetime, and a filled 64KB pipe would block the agent
+    log_dir = os.path.join(config.temp_dir, f"session_{session_id[:8]}", "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    stderr_path = os.path.join(log_dir, f"node-{uuid.uuid4().hex[:8]}.err")
+    stderr_f = open(stderr_path, "wb")
+    try:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.core.node_main",
+                "--control-address", control_address,
+                "--session-id", session_id,
+                "--resources", json.dumps(resources),
+                "--labels", json.dumps(labels or {}),
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=stderr_f,
+            start_new_session=True,
+        )
+    finally:
+        stderr_f.close()
+    import selectors
+
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+    try:
+        ready = sel.select(timeout=startup_timeout_s)
+    finally:
+        sel.close()
+    line = proc.stdout.readline().decode().strip() if ready else ""
+    if not line:
+        # EOF (startup crash) or hang: reap and surface the real cause
+        try:
+            proc.kill()
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            with open(stderr_path, "rb") as f:
+                tail = f.read()[-2000:].decode(errors="replace")
+        except OSError:
+            tail = ""
+        raise RuntimeError(
+            f"node agent spawn failed (rc={proc.returncode}): {tail}"
+        )
+    return proc, json.loads(line)
+
+
 class ClusterNode:
     def __init__(self, node_id: str, address: str, proc: subprocess.Popen):
         self.node_id = node_id
@@ -50,22 +113,9 @@ class Cluster:
         res = dict(resources or {})
         res["CPU"] = float(num_cpus)
         res["TPU"] = float(num_tpus)
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-        env["RT_CONFIG_SNAPSHOT"] = config.snapshot()
-        proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "ray_tpu.core.node_main",
-                "--control-address", self.address,
-                "--session-id", self.session_id,
-                "--resources", json.dumps(res),
-                "--labels", json.dumps(labels or {}),
-            ],
-            env=env, stdout=subprocess.PIPE, stderr=None, start_new_session=True,
+        proc, info = spawn_node_agent(
+            self.address, self.session_id, res, labels
         )
-        line = proc.stdout.readline().decode().strip()
-        info = json.loads(line)
         node = ClusterNode(info["node_id"], info["address"], proc)
         self.nodes.append(node)
         if wait:

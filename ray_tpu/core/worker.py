@@ -253,6 +253,35 @@ class _ActorRuntime:
         self.threads: List[threading.Thread] = []
         self.running = 0  # executions in flight (guarded by running_lock)
         self.running_lock = threading.Lock()
+        # Lazily-started asyncio loop for `async def` methods (reference:
+        # async actors run coroutines on one event loop, task_execution
+        # fiber/async queues): coroutines are scheduled here and the reply
+        # is sent from a done-callback, so thousands of IO-bound calls
+        # overlap without occupying executor threads.
+        self.loop = None
+        self.loop_lock = threading.Lock()
+        # async mode: ANY coroutine method makes every call run on the
+        # event loop (set at creation from the instance's methods)
+        import inspect
+
+        self.is_async = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(instance, callable)
+        )
+
+    def ensure_loop(self):
+        import asyncio
+
+        with self.loop_lock:
+            if self.loop is None:
+                self.loop = asyncio.new_event_loop()
+                t = threading.Thread(
+                    target=self.loop.run_forever,
+                    name="actor-asyncio", daemon=True,
+                )
+                t.start()
+                self.threads.append(t)
+            return self.loop
 
 
 class CoreWorker:
@@ -1255,6 +1284,16 @@ class CoreWorker:
                 conn, req_id, spec = rt.queue.get(timeout=0.5)
             except queue.Empty:
                 continue
+            if rt.is_async:
+                # Async actor (any `async def` method makes the WHOLE
+                # actor async, like the reference): every method runs on
+                # the one event loop — coroutines overlap at awaits, sync
+                # methods run to completion on the loop thread — so actor
+                # state is single-threaded and scheduling order follows
+                # submission order. The executor thread frees immediately;
+                # the reply is sent from a pool thread on completion.
+                self._execute_async_actor_task(conn, req_id, spec)
+                continue
             with rt.running_lock:
                 rt.running += 1
             try:
@@ -1263,6 +1302,71 @@ class CoreWorker:
                 with rt.running_lock:
                     rt.running -= 1
             RpcServer.reply(conn, req_id, True, reply)
+
+    def _execute_async_actor_task(self, conn, req_id, spec: TaskSpec) -> None:
+        import asyncio
+        import inspect
+
+        rt = self._actor_runtime
+        _t0 = time.time()
+        try:
+            target = getattr(rt.instance, spec.method_name)
+            args, kwargs = serialization.unpack(spec.args_frame)
+            args = [self._resolve_arg(a) for a in args]
+            kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
+            if inspect.iscoroutinefunction(target):
+                coro = target(*args, **kwargs)
+            else:
+                async def _sync_on_loop(t=target, a=args, kw=kwargs):
+                    return t(*a, **kw)
+
+                coro = _sync_on_loop()
+        except Exception as e:  # noqa: BLE001
+            RpcServer.reply(conn, req_id, True, {
+                "status": "error",
+                "error": TaskError(
+                    f"{type(e).__name__}: {e}", traceback.format_exc(),
+                    cause=e,
+                ),
+            })
+            return
+        with rt.running_lock:
+            rt.running += 1
+        fut = asyncio.run_coroutine_threadsafe(coro, rt.ensure_loop())
+
+        def _finish(f):
+            with rt.running_lock:
+                rt.running -= 1
+            try:
+                result = f.result()
+                reply = {
+                    "status": "ok",
+                    "returns": self._package_returns(spec, result),
+                }
+            except Exception as e:  # noqa: BLE001
+                reply = {
+                    "status": "error",
+                    "error": TaskError(
+                        f"{type(e).__name__}: {e}", traceback.format_exc(),
+                        cause=e,
+                    ),
+                }
+            self._task_events.append({
+                "name": spec.name or spec.method_name,
+                "task_id": spec.task_id.hex(),
+                "actor_id": spec.actor_id,
+                "ts_us": int(_t0 * 1e6),
+                "dur_us": int((time.time() - _t0) * 1e6),
+                "worker": self.address,
+                "pid": os.getpid(),
+            })
+            RpcServer.reply(conn, req_id, True, reply)
+
+        # the reply path serializes results and makes plasma RPCs — hand
+        # it to a pool thread so the event loop never blocks on it
+        fut.add_done_callback(
+            lambda f: self._submit_pool.submit(_finish, f)
+        )
 
     def rpc_actor_queue_stats(self, conn):
         """Queue depth + in-flight count for the hosted actor, served by

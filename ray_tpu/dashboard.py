@@ -53,8 +53,11 @@ def _table(rows, columns) -> str:
 
 
 class Dashboard:
-    def __init__(self, control_address: str, host: str = "0.0.0.0",
+    def __init__(self, control_address: str, host: str = "127.0.0.1",
                  port: int = 0):
+        # loopback by default: the JSON APIs are unauthenticated (the
+        # reference dashboard binds localhost for the same reason);
+        # exposing beyond the host is an explicit host= opt-in
         self.control_address = control_address
         dash = self
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.utils.rpc import ClientPool, RpcError
+from ray_tpu.utils.rpc import ClientPool, RpcConnectionError, RpcError
 
 # Pooled connections: the dashboard's 5s auto-refresh page renders several
 # state calls per view — dialing and closing a fresh socket per call would
@@ -66,8 +66,10 @@ def _agent_states(address: Optional[str]) -> List[Dict[str, Any]]:
             out.append(
                 _pool.get(n["address"]).call("get_state", timeout_s=10.0)
             )
+        except RpcConnectionError:
+            _pool.drop(n["address"])  # dead connection: rebuild next time
         except RpcError:
-            _pool.drop(n["address"])
+            pass  # slow, not dead: dropping would break concurrent users
     return out
 
 
@@ -140,8 +142,10 @@ def task_events(address: Optional[str] = None) -> List[Dict[str, Any]]:
             events.extend(
                 _pool.get(addr).call("get_task_events", timeout_s=10.0)
             )
-        except RpcError:
+        except RpcConnectionError:
             _pool.drop(addr)
+        except RpcError:
+            pass
     return events
 
 
@@ -177,8 +181,10 @@ def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:
     for addr in _worker_addresses(address):
         try:
             snap = _pool.get(addr).call("get_metrics", timeout_s=10.0)
-        except RpcError:
+        except RpcConnectionError:
             _pool.drop(addr)
+            continue
+        except RpcError:
             continue
         for name, m in snap.items():
             cur = merged.get(name)
