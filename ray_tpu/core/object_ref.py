@@ -1,4 +1,4 @@
-"""ObjectRef: a first-class future naming an object owned by some worker.
+"""ObjectRef + ObjectRefGenerator: a first-class future naming an object owned by some worker.
 
 Parity: ray.ObjectRef (python/ray/includes/object_ref.pxi). The ref carries
 its owner's address so any holder can locate the value without a directory
@@ -118,3 +118,64 @@ def _get_tracker():
     if w is None:
         return _null_tracker
     return w.reference_tracker
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded values (parity: the
+    reference's streaming generators, num_returns="streaming" — dynamic
+    return objects arrive as the executor produces them, long before the
+    task finishes).
+
+    Yields ObjectRefs in yield order; raises the task's error (if it
+    failed) when iteration reaches it. Owner-process only (the consumer
+    is the task's submitter)."""
+
+    def __init__(self, task_id, worker):
+        self._task_id = task_id
+        self._worker = worker
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        return self.next_ref()
+
+    def next_ref(self, timeout_s=None) -> "ObjectRef":
+        import time as _time
+
+        from ray_tpu.core import object_store as os_mod
+        from ray_tpu.core.exceptions import GetTimeoutError
+        from ray_tpu.utils.ids import ObjectID
+
+        w = self._worker
+        oid = ObjectID.from_task(self._task_id, self._i)
+        done_oid = w._stream_done_oid(self._task_id)
+        deadline = None if timeout_s is None else _time.monotonic() + timeout_s
+        while True:
+            if w.memory_store.contains(oid):
+                self._i += 1
+                return ObjectRef(oid, w.address)
+            marker = w.memory_store.try_get(done_oid)
+            if not os_mod.is_missing(marker):
+                if isinstance(marker, Exception):
+                    raise marker
+                if self._i >= int(marker):
+                    raise StopIteration
+                # count says item i exists but its push is still in
+                # flight on another connection: keep waiting
+            if deadline is not None and _time.monotonic() > deadline:
+                raise GetTimeoutError(
+                    f"streamed item {self._i} of task "
+                    f"{self._task_id.hex()} not available"
+                )
+            _time.sleep(0.005)
+
+    def completed(self) -> bool:
+        from ray_tpu.core import object_store as os_mod
+
+        return not os_mod.is_missing(
+            self._worker.memory_store.try_get(
+                self._worker._stream_done_oid(self._task_id)
+            )
+        )

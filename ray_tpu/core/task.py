@@ -48,6 +48,8 @@ def _merge_options(base: TaskOptions, **overrides) -> TaskOptions:
             continue
         if k == "num_gpus":  # accept the Ray-ism, map onto TPU chips
             k = "num_tpus"
+        if k == "num_returns" and v == "streaming":
+            v = -1  # wire sentinel for dynamic return count
         if not hasattr(merged, k):
             raise TypeError(f"unknown option {k!r}")
         setattr(merged, k, v)
@@ -90,8 +92,8 @@ class RemoteFunction:
             kwargs=kwargs,
             options=self._options,
         )
-        if self._options.num_returns == 1:
-            return refs[0]
+        if self._options.num_returns in (1, -1):
+            return refs[0]  # single ref, or the ObjectRefGenerator
         return refs
 
     def __call__(self, *args, **kwargs):

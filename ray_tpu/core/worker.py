@@ -797,10 +797,15 @@ class CoreWorker:
 
     def submit_task(self, fn_id, fn_name, args, kwargs, options: TaskOptions):
         task_id = self._next_task_id()
-        refs = [
-            ObjectRef(ObjectID.from_task(task_id, i), self.address)
-            for i in range(options.num_returns)
-        ]
+        if options.num_returns == -1:  # streaming generator
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            refs = [ObjectRefGenerator(task_id, self)]
+        else:
+            refs = [
+                ObjectRef(ObjectID.from_task(task_id, i), self.address)
+                for i in range(options.num_returns)
+            ]
         spec = TaskSpec(
             task_id=task_id,
             fn_id=fn_id,
@@ -935,8 +940,7 @@ class CoreWorker:
         for attempt in range(attempts):
             if spec.task_id.hex() in self._cancelled_tasks:
                 err = TaskCancelledError(f"task {spec.name} was cancelled")
-                for i in range(spec.num_returns):
-                    self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+                self._store_error_returns(spec, err)
                 return
             try:
                 self._run_task_on_lease(spec, strategy)
@@ -966,8 +970,7 @@ class CoreWorker:
             err = TaskError(
                 f"task {spec.name} failed after {attempts} attempts: {last_error}",
             )
-        for i in range(spec.num_returns):
-            self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+        self._store_error_returns(spec, err)
 
     def _maybe_reattach_agent(self) -> None:
         """Driver-only: if our node agent is unreachable, re-attach to a
@@ -1054,8 +1057,7 @@ class CoreWorker:
             except RpcError:
                 pass
             err = TaskCancelledError(f"task {spec.name} was cancelled")
-            for i in range(spec.num_returns):
-                self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+            self._store_error_returns(spec, err)
             return
         kill = False
         self._inflight_push[spec.task_id.hex()] = worker_addr
@@ -1078,7 +1080,39 @@ class CoreWorker:
             except RpcError:
                 pass
 
+    def _stream_done_oid(self, task_id: TaskID) -> ObjectID:
+        return ObjectID.from_task(task_id, self._STREAM_DONE_INDEX)
+
+    def _store_error_returns(self, spec: TaskSpec, err: Exception) -> None:
+        """Fail every return slot. Streaming tasks (num_returns == -1)
+        have no fixed slots: the error lands in the done-marker, which the
+        ObjectRefGenerator raises when it reaches it."""
+        if spec.num_returns == -1:
+            self.memory_store.put(self._stream_done_oid(spec.task_id), err)
+            return
+        for i in range(spec.num_returns):
+            self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+
+    def rpc_stream_item(self, conn, task_id_hex: str, index: int, payload):
+        """Owner side: one streamed generator item landed (in-order
+        oneway pushes from the executor)."""
+        oid = ObjectID.from_task(TaskID.from_hex(task_id_hex), index)
+        kind, data = payload
+        if kind == "frame":
+            self.memory_store.put(oid, data)
+        else:
+            path, size, agent_addr = data
+            self.memory_store.put(oid, PlasmaValue(path, size, agent_addr))
+        return True
+
     def _store_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        if reply["status"] == "ok" and spec.num_returns == -1:
+            # streaming: items arrived via rpc_stream_item pushes (possibly
+            # still in flight on another connection — the generator waits
+            # for item i even after seeing the count); store the count
+            count = reply["returns"][0][1]
+            self.memory_store.put(self._stream_done_oid(spec.task_id), count)
+            return
         if reply["status"] == "ok":
             for oid_hex, (kind, payload) in reply["returns"]:
                 oid = ObjectID.from_hex(oid_hex)
@@ -1092,14 +1126,12 @@ class CoreWorker:
                     self.delete_owned_object(oid)
         elif reply["status"] == "cancelled":
             err = TaskCancelledError(f"task {spec.name} was cancelled")
-            for i in range(spec.num_returns):
-                self.memory_store.put(ObjectID.from_task(spec.task_id, i), err)
+            self._store_error_returns(spec, err)
         else:
             error: TaskError = reply["error"]
             if spec.retry_exceptions:
                 raise error
-            for i in range(spec.num_returns):
-                self.memory_store.put(ObjectID.from_task(spec.task_id, i), error)
+            self._store_error_returns(spec, error)
 
     # ------------------------------------------------------------------
     # actor submission (reference actor_task_submitter.h)
@@ -1482,7 +1514,11 @@ class CoreWorker:
             return self._get_one(value, timeout_s=None)
         return value
 
+    _STREAM_DONE_INDEX = 2**31 - 1  # sentinel return slot: item count
+
     def _package_returns(self, spec: TaskSpec, result: Any) -> List[Tuple[str, Any]]:
+        if spec.num_returns == -1:
+            return self._stream_returns(spec, result)
         if spec.num_returns == 1:
             values = [result]
         else:
@@ -1508,6 +1544,35 @@ class CoreWorker:
             else:
                 returns.append((oid.hex(), ("frame", frame)))
         return returns
+
+    def _stream_returns(self, spec: TaskSpec, result: Any) -> List[Tuple[str, Any]]:
+        """num_returns="streaming": push each yielded value to the OWNER
+        as it is produced (reference: streaming generators,
+        task_manager's dynamic returns) — the consumer's
+        ObjectRefGenerator sees item i long before the task finishes.
+        Items ride in-order oneway RPCs; big items go through plasma and
+        only their marker travels."""
+        owner = self.workers.get(spec.owner_address)
+        count = 0
+        for value in result:
+            oid = ObjectID.from_task(spec.task_id, count)
+            frame = serialization.pack(value)
+            if len(frame) > config.max_direct_call_object_size:
+                path = self.agent.call(
+                    "create_object", oid_hex=oid.hex(), size=len(frame)
+                )
+                self.shm.write(path, frame)
+                self.agent.call("seal_object", oid_hex=oid.hex())
+                payload = ("plasma", (path, len(frame), self.node_agent_address))
+            else:
+                payload = ("frame", frame)
+            owner.call_oneway(
+                "stream_item", task_id_hex=spec.task_id.hex(),
+                index=count, payload=payload,
+            )
+            count += 1
+        # the count marker travels on the ordinary reply path
+        return [("__stream_count__", count)]
 
     # -- object service (owner side) --
 

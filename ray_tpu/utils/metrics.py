@@ -35,11 +35,19 @@ class _Metric:
         self._series: Dict[Tuple[str, ...], object] = {}
         with _lock:
             existing = _registry.get(name)
-            if existing is not None and existing.kind != self.kind:
-                raise ValueError(
-                    f"metric {name!r} already registered as {existing.kind}"
-                )
-            _registry[name] = self
+            if existing is not None:
+                if existing.kind != self.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}"
+                    )
+                # per-name singleton series: re-constructing a metric
+                # (e.g. inside a task that runs repeatedly on one worker)
+                # must accumulate into the SAME series, not reset it
+                self._series = existing._series
+                self._lock = existing._lock
+            else:
+                _registry[name] = self
 
     def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
         tags = tags or {}
