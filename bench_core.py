@@ -1,0 +1,197 @@
+"""Core-plane microbenchmarks (parity: reference ray_perf.py workloads,
+/root/reference/python/ray/_private/ray_perf.py:95-317).
+
+Measures the control/data plane, not the TPU: task submit+get throughput,
+async task fan-out, 1:1 and 1:n actor calls, async-actor calls, put/get
+small and large, many-ref get, wait latency, compiled-DAG round trip, and
+RDT device-object transfer vs the pickle path.
+
+Run: python bench_core.py  → one JSON object per line, plus a summary
+file BENCH_CORE.json with every metric.
+"""
+
+import json
+import time
+
+
+def timed(fn, n, warmup=5):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    dt = time.perf_counter() - t0
+    return n / dt, dt / n
+
+
+def main():
+    import numpy as np
+
+    import ray_tpu
+
+    # generous virtual CPU count: every actor in this suite holds a CPU
+    # lease for its lifetime, and the point is to measure the core plane,
+    # not to starve it of slots
+    ray_tpu.init(num_cpus=32)
+    results = {}
+
+    def record(name, per_s, unit="calls/s"):
+        results[name] = {"value": round(per_s, 1), "unit": unit}
+        print(json.dumps({"metric": name, "value": round(per_s, 1), "unit": unit}),
+              flush=True)
+
+    # -- tasks ----------------------------------------------------------
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    per_s, _ = timed(lambda: ray_tpu.get(nop.remote()), 60)
+    record("task_submit_and_get_sync", per_s)
+
+    def batch_async():
+        ray_tpu.get([nop.remote() for _ in range(40)])
+
+    per_s, lat = timed(batch_async, 8)
+    record("tasks_async_batch40", 40 / lat, "tasks/s")
+
+    # -- actors ---------------------------------------------------------
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.x = 0
+
+        def inc(self):
+            self.x += 1
+            return self.x
+
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    per_s, _ = timed(lambda: ray_tpu.get(c.inc.remote()), 200)
+    record("actor_call_sync", per_s)
+
+    def actor_async():
+        ray_tpu.get([c.inc.remote() for _ in range(100)])
+
+    per_s, lat = timed(actor_async, 10)
+    record("actor_calls_async_batch100", 100 / lat, "calls/s")
+
+    counters = [Counter.remote() for _ in range(4)]
+    ray_tpu.get([cc.inc.remote() for cc in counters])
+
+    def one_to_n():
+        ray_tpu.get([cc.inc.remote() for cc in counters for _ in range(25)])
+
+    per_s, lat = timed(one_to_n, 10)
+    record("actor_calls_1_to_4_batch100", 100 / lat, "calls/s")
+
+    @ray_tpu.remote
+    class AsyncActor:
+        async def ping(self):
+            return 1
+
+    aa = AsyncActor.remote()
+    ray_tpu.get(aa.ping.remote())
+
+    def async_actor_batch():
+        ray_tpu.get([aa.ping.remote() for _ in range(100)])
+
+    per_s, lat = timed(async_actor_batch, 10)
+    record("async_actor_calls_batch100", 100 / lat, "calls/s")
+
+    # -- objects --------------------------------------------------------
+    small = {"k": list(range(10))}
+    per_s, _ = timed(lambda: ray_tpu.get(ray_tpu.put(small)), 300)
+    record("put_get_small", per_s, "roundtrips/s")
+
+    big = np.zeros((1024, 1024), dtype=np.float32)  # 4 MB -> plasma
+    per_s, lat = timed(lambda: ray_tpu.get(ray_tpu.put(big)), 30)
+    record("put_get_4mb_plasma", per_s, "roundtrips/s")
+    record("put_get_4mb_bandwidth", 4.0 / lat, "MiB/s")
+
+    refs = [ray_tpu.put(i) for i in range(10000)]
+    t0 = time.perf_counter()
+    got = ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    assert got[-1] == 9999
+    record("get_10k_refs", 10000 / dt, "objects/s")
+    del refs, got
+
+    refs = [ray_tpu.put(i) for i in range(1000)]
+    t0 = time.perf_counter()
+    ready, _ = ray_tpu.wait(refs, num_returns=1000, timeout=30)
+    dt = time.perf_counter() - t0
+    assert len(ready) == 1000
+    record("wait_1k_ready_refs", 1000 / dt, "refs/s")
+
+    # wait() latency on an already-ready ref (VERDICT target: <=1ms)
+    r = ray_tpu.put(1)
+    t0 = time.perf_counter()
+    loops = 200
+    for _ in range(loops):
+        ray_tpu.wait([r], num_returns=1)
+    lat_ms = (time.perf_counter() - t0) / loops * 1e3
+    record("wait_ready_latency_ms", lat_ms, "ms")
+
+    # -- compiled DAG vs RPC path --------------------------------------
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Echo:
+        def echo(self, x):
+            return x
+
+    e = Echo.remote()
+    ray_tpu.get(e.echo.remote(0))
+    per_s, rpc_lat = timed(lambda: ray_tpu.get(e.echo.remote(1)), 200)
+    with InputNode() as inp:
+        dag = e.echo.bind(inp)
+    cdag = dag.experimental_compile()
+    try:
+        per_s, dag_lat = timed(lambda: cdag.execute(1).get(), 2000, warmup=50)
+        record("compiled_dag_call", per_s)
+        record("compiled_dag_vs_rpc_speedup", rpc_lat / dag_lat, "x")
+    finally:
+        cdag.teardown()
+
+    # -- RDT device objects vs pickle path ------------------------------
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self, n):
+            import jax.numpy as jnp
+
+            return jnp.zeros((n, 1024))
+
+    @ray_tpu.remote
+    class Consumer:
+        def total(self, arr):
+            return float(arr.sum())
+
+    p, cns = Producer.remote(), Consumer.remote()
+    n_rows = 1024  # 4 MiB fp32
+
+    def handoff_pickle():
+        ref = p.make.remote(n_rows)
+        return ray_tpu.get(cns.total.remote(ref))
+
+    per_s, pickle_lat = timed(handoff_pickle, 20, warmup=3)
+    record("actor_handoff_4mb_pickle", per_s, "handoffs/s")
+
+    def handoff_device():
+        ref = p.make.options(tensor_transport="device").remote(n_rows)
+        return ray_tpu.get(cns.total.remote(ref))
+
+    per_s, dev_lat = timed(handoff_device, 20, warmup=3)
+    record("actor_handoff_4mb_device", per_s, "handoffs/s")
+    record("rdt_vs_pickle_speedup", pickle_lat / dev_lat, "x")
+
+    with open("BENCH_CORE.json", "w") as f:
+        json.dump(results, f, indent=2)
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -46,7 +46,7 @@ from ray_tpu.core import exceptions
 def __getattr__(name):
     # Lazy subpackage access (`ray_tpu.data` after `import ray_tpu`)
     # without importing heavyweight libraries at top level.
-    if name in ("data", "train", "serve", "tune", "collective"):
+    if name in ("data", "train", "serve", "tune", "collective", "dag"):
         import importlib
 
         try:

@@ -22,11 +22,18 @@ _ACTOR_OPTION_KEYS = {
 }
 
 
-def method(num_returns: int = 1):
-    """Decorator configuring an actor method (parity: ray.method)."""
+def method(num_returns: int = 1, tensor_transport: str = "object"):
+    """Decorator configuring an actor method (parity: ray.method —
+    including the RDT ``tensor_transport`` option, reference
+    gpu_object_manager.py: ``@ray.method(tensor_transport=...)``)."""
+
+    from ray_tpu.core.device_objects import validate_transport
+
+    validate_transport(tensor_transport)
 
     def wrap(fn):
         fn.__rt_num_returns__ = num_returns
+        fn.__rt_tensor_transport__ = tensor_transport
         return fn
 
     return wrap
@@ -60,12 +67,14 @@ class ActorClass:
             self._class_id = "cls_" + hashlib.sha1(blob).hexdigest()[:24]
         return self._class_id, self._blob
 
-    def _method_meta(self) -> Dict[str, int]:
-        meta = {}
+    def _method_meta(self) -> Dict[str, Any]:
+        meta: Dict[str, Any] = {}
         for name, fn in inspect.getmembers(self._cls, callable):
             if name.startswith("__") and name != "__call__":
                 continue
-            meta[name] = getattr(fn, "__rt_num_returns__", 1)
+            nr = getattr(fn, "__rt_num_returns__", 1)
+            tt = getattr(fn, "__rt_tensor_transport__", "object")
+            meta[name] = nr if tt == "object" else (nr, tt)
         return meta
 
     def remote(self, *args, **kwargs) -> "ActorHandle":
@@ -99,15 +108,24 @@ class ActorClass:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1,
+                 tensor_transport: str = "object"):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._tensor_transport = tensor_transport
 
-    def options(self, num_returns: Optional[int] = None) -> "ActorMethod":
+    def options(self, num_returns: Optional[int] = None,
+                tensor_transport: Optional[str] = None) -> "ActorMethod":
+        if tensor_transport is not None:
+            from ray_tpu.core.device_objects import validate_transport
+
+            validate_transport(tensor_transport)
         return ActorMethod(
             self._handle, self._name,
             num_returns if num_returns is not None else self._num_returns,
+            tensor_transport if tensor_transport is not None
+            else self._tensor_transport,
         )
 
     def remote(self, *args, **kwargs):
@@ -117,10 +135,18 @@ class ActorMethod:
         refs = w.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
             num_returns=self._num_returns,
+            tensor_transport=self._tensor_transport,
         )
         if self._num_returns == 1:
             return refs[0]
         return refs
+
+    def bind(self, *args):
+        """Create a static-DAG node for this method (compiled graphs,
+        ray_tpu/dag.py; parity: python/ray/dag/dag_node.py bind)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -148,7 +174,12 @@ class ActorHandle:
             raise AttributeError(
                 f"actor {self._class_name} has no method {name!r}"
             )
-        return ActorMethod(self, name, self._method_meta.get(name, 1))
+        meta = self._method_meta.get(name, 1)
+        if isinstance(meta, tuple):
+            num_returns, transport = meta
+        else:
+            num_returns, transport = meta, "object"
+        return ActorMethod(self, name, num_returns, transport)
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id[:8]})"

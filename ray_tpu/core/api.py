@@ -149,10 +149,17 @@ def remote(*args, **options):
     return decorate
 
 
-def put(value: Any) -> ObjectRef:
+def put(value: Any, *, _tensor_transport: str = "object") -> ObjectRef:
+    """Store a value and return a ref. ``_tensor_transport="device"``
+    keeps jax.Array leaves device-resident (TPU-RDT; parity:
+    ray.put(_tensor_transport=...), reference gpu_object_manager)."""
+    from ray_tpu.core.device_objects import validate_transport
+
     if isinstance(value, ObjectRef):
         raise TypeError("put() of an ObjectRef is not allowed")
-    return worker_mod.global_worker().put(value)
+    return worker_mod.global_worker().put(
+        value, tensor_transport=validate_transport(_tensor_transport)
+    )
 
 
 def get(

@@ -137,11 +137,32 @@ class Cluster:
             client.close()
 
     def kill_node(self, node: ClusterNode) -> None:
-        """Hard-kill a node agent (and its workers) for FT tests."""
+        """Hard-kill a node agent AND its workers for FT tests. Workers
+        run in their own sessions (start_new_session), so killing the
+        agent's group alone would leave them orphaned and split-brain
+        until their agent-watchdog fires — a real machine death takes
+        everything down at once, and so must this simulation."""
+        worker_pids: List[int] = []
+        try:
+            out = subprocess.run(
+                ["pgrep", "-P", str(node.proc.pid)],
+                capture_output=True, text=True, timeout=5,
+            ).stdout
+            worker_pids = [int(p) for p in out.split()]
+        except Exception:  # noqa: BLE001
+            pass
         try:
             os.killpg(os.getpgid(node.proc.pid), 9)
         except (ProcessLookupError, PermissionError):
             node.proc.kill()
+        for pid in worker_pids:
+            try:
+                os.killpg(os.getpgid(pid), 9)
+            except (ProcessLookupError, PermissionError):
+                try:
+                    os.kill(pid, 9)
+                except ProcessLookupError:
+                    pass
         node.proc.wait()
         client = RpcClient(self.address, name="cluster-kill")
         try:

@@ -17,8 +17,11 @@ hook mirrors the Redis-backed FT mode and can be added behind StoreBackend).
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import logging
 import os
+import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -80,6 +83,27 @@ class ControlStore:
         self._stopped = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
 
+        # Scheduling queue (reference GcsActorScheduler/PG scheduler run
+        # on the GCS io-service, not a thread per entity): ONE dispatcher
+        # thread drains this queue; lease/create RPCs go out async and
+        # their completions re-enqueue follow-up items, so thread count
+        # stays flat no matter how many actors/PGs are pending.
+        self._sched_q: "queue.Queue" = queue.Queue()
+        self._sched_retries: List[Tuple[float, int, tuple]] = []  # heap
+        self._sched_seq = itertools.count()
+        self._sched_backoff: Dict[tuple, float] = {}
+        self._sched_retry_lock = threading.Lock()  # heap+backoff (pg pool
+        # threads and the dispatcher both retry/enqueue)
+        # PG 2PC does synchronous prepare/commit RPCs; a hung agent must
+        # not stall the (async) actor pipeline, so PG passes run on a
+        # small fixed pool instead of the dispatcher thread.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pg_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="cs-pg"
+        )
+        self._pg_running: set = set()
+
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
@@ -91,6 +115,9 @@ class ControlStore:
             target=self._health_loop, name="cs-health", daemon=True
         )
         self._health_thread.start()
+        threading.Thread(
+            target=self._sched_loop, name="cs-scheduler", daemon=True
+        ).start()
         if self._persistence_path:
             threading.Thread(
                 target=self._persist_loop, name="cs-persist", daemon=True
@@ -98,6 +125,7 @@ class ControlStore:
 
     def stop(self) -> None:
         self._stopped.set()
+        self._pg_pool.shutdown(wait=False)
         self._persist(force=True)
         self._server.stop()
         self._agents.close_all()
@@ -191,6 +219,39 @@ class ControlStore:
         with self._lock:
             for subs in self._subs.values():
                 subs.pop(id(conn), None)
+        node_id = getattr(conn, "node_id", None)
+        if node_id is not None:
+            # Fast failure detection: the agent's heartbeat connection
+            # broke. Confirm with a short grace (a live agent re-heartbeats
+            # on a fresh connection within one period) before declaring
+            # death — much faster than the full health_check_timeout_s.
+            threading.Thread(
+                target=self._confirm_node_death, args=(node_id,),
+                name="cs-conn-death", daemon=True,
+            ).start()
+
+    def _confirm_node_death(self, node_id: str) -> None:
+        t_break = time.monotonic()
+        grace = 2.5 * config.health_check_period_s
+        while time.monotonic() - t_break < grace:
+            if self._stopped.wait(0.25):
+                return
+            with self._lock:
+                node = self._nodes.get(node_id)
+                if node is None or not node["alive"]:
+                    return
+                if node["last_heartbeat"] > t_break:
+                    return  # re-heartbeated on a fresh connection: alive
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node["alive"] or (
+                node["last_heartbeat"] > t_break
+            ):
+                return
+        logger.warning(
+            "node %s heartbeat connection lost; marking dead", node_id[:8]
+        )
+        self._mark_node_dead(node_id, "heartbeat connection lost")
 
     # ------------------------------------------------------------------
     # KV (reference C14 / internal KV)
@@ -263,6 +324,8 @@ class ControlStore:
             }
         logger.info("node %s registered at %s", node_id[:8], node_info["address"])
         self.publish("node", {"event": "added", "node": self._public_node(node_id)})
+        # fresh capacity: retry anything the scheduler had parked
+        self._sched_enqueue(("kick",))
         return {"config_snapshot": config.snapshot(), "session_id": self.session_id}
 
     def rpc_heartbeat(self, conn, node_id: str, resources_available: Dict[str, float],
@@ -272,6 +335,10 @@ class ControlStore:
             node = self._nodes.get(node_id)
             if node is None or not node["alive"]:
                 return {"ok": False}  # tells a zombie agent to exit
+            # Tag the transport so a broken agent connection fast-paths
+            # failure detection (reference: GCS treats the raylet channel
+            # break as a death signal, not just missed heartbeats).
+            conn.node_id = node_id
             node["last_heartbeat"] = time.monotonic()
             node["resources_available"] = resources_available
             node["pending_leases"] = pending_leases
@@ -307,6 +374,7 @@ class ControlStore:
             "alive": n["alive"],
             "pending_leases": n.get("pending_leases", 0),
             "active_leases": n.get("active_leases", 0),
+            "pending_shapes": n.get("pending_shapes", []),
         }
 
     def _health_loop(self) -> None:
@@ -355,10 +423,7 @@ class ControlStore:
         for actor in affected_actors:
             self._on_actor_worker_lost(actor["actor_id"], f"node died: {reason}")
         for pg_id in replaced_pgs:
-            threading.Thread(
-                target=self._schedule_pg, args=(pg_id,),
-                name=f"cs-resched-pg-{pg_id[:8]}", daemon=True,
-            ).start()
+            self._sched_enqueue(("pg", pg_id))
 
     # ------------------------------------------------------------------
     # jobs
@@ -434,103 +499,219 @@ class ControlStore:
                 "death_cause": None,
             }
             self._actors[actor_id] = record
-        threading.Thread(
-            target=self._schedule_actor, args=(actor_id,),
-            name=f"cs-sched-actor-{actor_id[:8]}", daemon=True,
-        ).start()
+        self._sched_enqueue(("actor", actor_id))
         return True
 
-    def _schedule_actor(self, actor_id: str) -> None:
-        backoff = 0.05
+    # -- scheduling queue (reference: GcsActorScheduler + PG scheduler on
+    # -- the GCS io-service; one dispatcher, async RPC continuations) ----
+
+    def _sched_enqueue(self, item: tuple) -> None:
+        self._sched_q.put(item)
+
+    def _sched_retry(self, item: tuple, key: tuple) -> None:
+        """Re-enqueue after this key's (exponential, capped) backoff."""
+        with self._sched_retry_lock:
+            backoff = self._sched_backoff.get(key, 0.05)
+            self._sched_backoff[key] = min(backoff * 2, 2.0)
+            heapq.heappush(
+                self._sched_retries,
+                (time.monotonic() + backoff, next(self._sched_seq), item),
+            )
+
+    def _sched_kick(self) -> None:
+        """Cluster capacity changed (node joined): retry everything now."""
+        with self._sched_retry_lock:
+            items = [it for _, _, it in self._sched_retries]
+            self._sched_retries.clear()
+        for it in items:
+            self._sched_q.put(it)
+
+    def _sched_loop(self) -> None:
         while not self._stopped.is_set():
-            with self._lock:
-                record = self._actors.get(actor_id)
-                if record is None or record["state"] in (ActorState.DEAD, ActorState.ALIVE):
-                    return
-                view = self._cluster_view_locked()
-                strategy = record.get("scheduling_strategy")
-                resources = record.get("resources", {})
-            node_id = scheduling.pick_node(view, resources, strategy, self._pgs, self._lock)
-            if node_id is None:
-                time.sleep(min(backoff, 1.0))
-                backoff *= 2
-                continue
-            agent_addr = view[node_id]["address"]
+            now = time.monotonic()
+            with self._sched_retry_lock:
+                while self._sched_retries and self._sched_retries[0][0] <= now:
+                    _, _, item = heapq.heappop(self._sched_retries)
+                    self._sched_q.put(item)
+                timeout = 0.5
+                if self._sched_retries:
+                    timeout = min(timeout, self._sched_retries[0][0] - now)
             try:
-                lease = self._agents.get(agent_addr).call(
-                    "lease_worker",
-                    resources=resources,
-                    bundle=scheduling.pg_bundle_of(record.get("scheduling_strategy")),
-                    wait_s=config.worker_register_timeout_s,
-                    timeout_s=config.worker_register_timeout_s + 15,
-                )
-            except RpcError as e:
-                logger.warning("actor %s lease on %s failed: %s", actor_id[:8], node_id[:8], e)
-                time.sleep(min(backoff, 1.0))
-                backoff *= 2
+                item = self._sched_q.get(timeout=max(timeout, 0.005))
+            except queue.Empty:
                 continue
-            if not lease.get("granted"):
-                time.sleep(min(backoff, 1.0))
-                backoff *= 2
-                continue
-            worker_addr = lease["worker_address"]
-            with self._lock:
-                record = self._actors.get(actor_id)
-                if record is None or record["state"] == ActorState.DEAD:
-                    # killed while scheduling; return the lease
-                    try:
-                        self._agents.get(agent_addr).call_oneway(
-                            "release_worker", lease_id=lease["lease_id"], kill=False
-                        )
-                    except RpcError:
-                        pass
-                    return
-                spec = dict(record)
             try:
-                created = self._workers.get(worker_addr).call(
-                    "create_actor", spec=spec,
-                    timeout_s=config.rpc_request_timeout_s,
-                )
-            except RpcError as e:
-                # transport failure: worker unusable, retry elsewhere
-                logger.warning("actor %s creation on %s failed: %s", actor_id[:8], worker_addr, e)
+                self._process_sched(item)
+            except Exception:  # noqa: BLE001 — scheduler must survive
+                logger.exception("scheduler item %s failed", item[:1])
+
+    def _process_sched(self, item: tuple) -> None:
+        kind = item[0]
+        if kind == "actor":
+            self._sched_actor_place(item[1])
+        elif kind == "actor_lease":
+            self._sched_actor_leased(*item[1:])
+        elif kind == "actor_created":
+            self._sched_actor_created(*item[1:])
+        elif kind == "pg":
+            pg_id = item[1]
+            with self._lock:
+                if pg_id in self._pg_running:
+                    # a pass for this PG is already on the pool: coalesce
+                    # (it re-enqueues itself on progress/backoff)
+                    self._sched_retry(("pg", pg_id), ("pg", pg_id))
+                    return
+                self._pg_running.add(pg_id)
+
+            def run(pg_id=pg_id):
+                again = False
                 try:
-                    self._agents.get(agent_addr).call_oneway(
-                        "release_worker", lease_id=lease["lease_id"], kill=True
-                    )
-                except RpcError:
-                    pass
-                time.sleep(min(backoff, 1.0))
-                backoff *= 2
-                continue
-            if not created.get("ok"):
-                # __init__ raised: permanent, surface the error to callers
-                try:
-                    self._agents.get(agent_addr).call_oneway(
-                        "release_worker", lease_id=lease["lease_id"], kill=True
-                    )
-                except RpcError:
-                    pass
-                with self._lock:
-                    record = self._actors.get(actor_id)
-                    if record is not None:
-                        record["state"] = ActorState.DEAD
-                        record["death_cause"] = str(created.get("error"))
-                self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
-                self.publish("actor", self._public_actor(actor_id))
+                    again = bool(self._schedule_pg_once(pg_id))
+                finally:
+                    with self._lock:
+                        self._pg_running.discard(pg_id)
+                    if again:
+                        # enqueue only AFTER leaving _pg_running: enqueueing
+                        # inside the pass would hit the coalesce branch and
+                        # defer the (usually final) CREATED transition by a
+                        # backoff cycle
+                        self._sched_enqueue(("pg", pg_id))
+
+            self._pg_pool.submit(run)
+        elif kind == "kick":
+            self._sched_kick()
+
+    def _sched_actor_place(self, actor_id: str) -> None:
+        """Step 1: pick a node and fire an async lease request."""
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None or record["state"] in (
+                ActorState.DEAD, ActorState.ALIVE,
+            ):
                 return
+            view = self._cluster_view_locked()
+            strategy = record.get("scheduling_strategy")
+            resources = record.get("resources", {})
+        node_id = scheduling.pick_node(
+            view, resources, strategy, self._pgs, self._lock
+        )
+        if node_id is None:
+            self._sched_retry(("actor", actor_id), ("actor", actor_id))
+            return
+        agent_addr = view[node_id]["address"]
+        try:
+            pend = self._agents.get(agent_addr).call_async(
+                "lease_worker",
+                resources=resources,
+                bundle=scheduling.pg_bundle_of(strategy),
+                wait_s=0.0,
+            )
+        except RpcError as e:
+            logger.warning(
+                "actor %s lease on %s failed: %s", actor_id[:8], node_id[:8], e
+            )
+            self._sched_retry(("actor", actor_id), ("actor", actor_id))
+            return
+        pend.add_done_callback(
+            lambda p: self._sched_q.put(
+                ("actor_lease", actor_id, node_id, agent_addr, p)
+            )
+        )
+
+    def _sched_actor_leased(self, actor_id, node_id, agent_addr, pend) -> None:
+        """Step 2: lease reply arrived; fire async actor creation."""
+        try:
+            lease = pend.wait(0)
+        except RpcError as e:
+            logger.warning("actor %s lease failed: %s", actor_id[:8], e)
+            self._sched_retry(("actor", actor_id), ("actor", actor_id))
+            return
+        if not lease.get("granted"):
+            self._sched_retry(("actor", actor_id), ("actor", actor_id))
+            return
+        worker_addr = lease["worker_address"]
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None or record["state"] == ActorState.DEAD:
+                # killed while scheduling; return the lease
+                try:
+                    self._agents.get(agent_addr).call_oneway(
+                        "release_worker", lease_id=lease["lease_id"], kill=False
+                    )
+                except RpcError:
+                    pass
+                return
+            spec = dict(record)
+        try:
+            pend2 = self._workers.get(worker_addr).call_async(
+                "create_actor", spec=spec
+            )
+        except RpcError as e:
+            logger.warning(
+                "actor %s creation on %s failed: %s", actor_id[:8], worker_addr, e
+            )
+            try:
+                self._agents.get(agent_addr).call_oneway(
+                    "release_worker", lease_id=lease["lease_id"], kill=True
+                )
+            except RpcError:
+                pass
+            self._sched_retry(("actor", actor_id), ("actor", actor_id))
+            return
+        pend2.add_done_callback(
+            lambda p: self._sched_q.put(
+                ("actor_created", actor_id, node_id, agent_addr, lease, p)
+            )
+        )
+
+    def _sched_actor_created(
+        self, actor_id, node_id, agent_addr, lease, pend
+    ) -> None:
+        """Step 3: creation reply arrived; finalize ALIVE/DEAD/retry."""
+        try:
+            created = pend.wait(0)
+        except RpcError as e:
+            # transport failure: worker unusable, retry elsewhere
+            logger.warning(
+                "actor %s creation push failed: %s", actor_id[:8], e
+            )
+            try:
+                self._agents.get(agent_addr).call_oneway(
+                    "release_worker", lease_id=lease["lease_id"], kill=True
+                )
+            except RpcError:
+                pass
+            self._sched_retry(("actor", actor_id), ("actor", actor_id))
+            return
+        if not created.get("ok"):
+            # __init__ raised: permanent, surface the error to callers
+            try:
+                self._agents.get(agent_addr).call_oneway(
+                    "release_worker", lease_id=lease["lease_id"], kill=True
+                )
+            except RpcError:
+                pass
             with self._lock:
                 record = self._actors.get(actor_id)
-                if record is None:
-                    return
-                record["state"] = ActorState.ALIVE
-                record["node_id"] = node_id
-                record["worker_address"] = worker_addr
-                record["lease_id"] = lease["lease_id"]
-                record["agent_address"] = agent_addr
+                if record is not None:
+                    record["state"] = ActorState.DEAD
+                    record["death_cause"] = str(created.get("error"))
+            self._sched_backoff.pop(("actor", actor_id), None)
             self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
             self.publish("actor", self._public_actor(actor_id))
             return
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None:
+                return
+            record["state"] = ActorState.ALIVE
+            record["node_id"] = node_id
+            record["worker_address"] = lease["worker_address"]
+            record["lease_id"] = lease["lease_id"]
+            record["agent_address"] = agent_addr
+        self._sched_backoff.pop(("actor", actor_id), None)
+        self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
+        self.publish("actor", self._public_actor(actor_id))
 
     def rpc_get_actor_info(self, conn, actor_id: str):
         with self._lock:
@@ -657,10 +838,7 @@ class ControlStore:
         self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
         self.publish("actor", self._public_actor(actor_id))
         if restart:
-            threading.Thread(
-                target=self._schedule_actor, args=(actor_id,),
-                name=f"cs-resched-actor-{actor_id[:8]}", daemon=True,
-            ).start()
+            self._sched_enqueue(("actor", actor_id))
 
     def _public_actor(self, actor_id: str) -> Dict[str, Any]:
         r = self._actors[actor_id]
@@ -699,14 +877,14 @@ class ControlStore:
                 # bundle index -> node_id hex
                 "bundle_locations": {},
             }
-        threading.Thread(
-            target=self._schedule_pg, args=(pg_id,),
-            name=f"cs-sched-pg-{pg_id[:8]}", daemon=True,
-        ).start()
+        self._sched_enqueue(("pg", pg_id))
         return True
 
-    def _schedule_pg(self, pg_id: str) -> None:
-        """Place (or re-place) a PG's bundles via 2PC.
+    def _schedule_pg_once(self, pg_id: str) -> bool:
+        """One placement pass of a PG's missing bundles via 2PC (runs on
+        the scheduler thread; infeasible/failed passes re-enqueue with
+        backoff instead of parking a thread). Returns True when the caller
+        should run another pass immediately (progress was made).
 
         Handles partial placement: only indices absent from
         bundle_locations are placed, so node-death recovery re-places the
@@ -714,92 +892,91 @@ class ControlStore:
         running — mirroring the reference GcsPlacementGroupManager's
         rescheduling of individual bundles.
         """
-        backoff = 0.05
-        while not self._stopped.is_set():
-            with self._lock:
-                pg = self._pgs.get(pg_id)
-                if pg is None or pg["state"] in (PGState.CREATED, PGState.REMOVED):
-                    return
-                bundles = pg["bundles"]
-                strategy = pg["strategy"]
-                locations = {int(k): v for k, v in pg["bundle_locations"].items()}
-                view = self._cluster_view_locked()
-            missing = [i for i in range(len(bundles)) if i not in locations]
-            if not missing:
-                with self._lock:
-                    pg = self._pgs.get(pg_id)
-                    if pg is None or pg["state"] == PGState.REMOVED:
-                        return
-                    pg["state"] = PGState.CREATED
-                self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.CREATED})
+        key = ("pg", pg_id)
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None or pg["state"] in (PGState.CREATED, PGState.REMOVED):
+                self._sched_backoff.pop(key, None)
                 return
-            place_view = view
-            if strategy == "STRICT_SPREAD" and locations:
-                survivors = set(locations.values())
-                place_view = {
-                    nid: n for nid, n in view.items() if nid not in survivors
-                }
-            sub = scheduling.place_bundles(
-                place_view, [bundles[i] for i in missing], strategy
-            )
-            if sub is None:
-                time.sleep(min(backoff, 1.0))
-                backoff = min(backoff * 2, 1.0)
-                continue
-            placement = {missing[pos]: nid for pos, nid in sub.items()}
-            # Phase 1: PREPARE on every involved agent.
-            by_node: Dict[str, List[int]] = {}
-            for idx, node_id in placement.items():
-                by_node.setdefault(node_id, []).append(idx)
-            ok = True
-            for node_id, idxs in by_node.items():
-                addr = view[node_id]["address"]
-                try:
-                    res = self._agents.get(addr).call(
-                        "prepare_bundles", pg_id=pg_id,
-                        bundles={i: bundles[i] for i in idxs},
-                    )
-                except RpcError:
-                    res = False
-                if not res:
-                    ok = False
-                    break
-            if not ok:
-                # Roll back EVERY node in the attempted placement (by its
-                # attempted indices), not just the ones that acked prepare:
-                # a node whose prepare reply was lost may still hold the
-                # reservation, and return_bundles on a node that never
-                # prepared those indices is a no-op. Synchronous call so a
-                # retried placement can't race its own rollback.
-                self._rollback_bundles(view, by_node, pg_id)
-                time.sleep(min(backoff, 1.0))
-                backoff = min(backoff * 2, 1.0)
-                continue
-            # Phase 2: COMMIT. A node that misses COMMIT would refuse
-            # bundle leases forever (raylet requires state=="committed"),
-            # so any commit failure rolls this placement back and retries.
-            commit_ok = True
-            for node_id, idxs in by_node.items():
-                try:
-                    res = self._agents.get(view[node_id]["address"]).call(
-                        "commit_bundles", pg_id=pg_id
-                    )
-                except RpcError:
-                    res = False
-                if not res:
-                    logger.warning("pg %s commit failed on %s", pg_id[:8], node_id[:8])
-                    commit_ok = False
-            if not commit_ok:
-                self._rollback_bundles(view, by_node, pg_id)
-                time.sleep(min(backoff, 1.0))
-                backoff = min(backoff * 2, 1.0)
-                continue
+            bundles = pg["bundles"]
+            strategy = pg["strategy"]
+            locations = {int(k): v for k, v in pg["bundle_locations"].items()}
+            view = self._cluster_view_locked()
+        missing = [i for i in range(len(bundles)) if i not in locations]
+        if not missing:
             with self._lock:
                 pg = self._pgs.get(pg_id)
-                if pg is None:
+                if pg is None or pg["state"] == PGState.REMOVED:
                     return
-                pg["bundle_locations"].update(placement)
-            # loop once more: recompute missing (usually empty -> CREATED)
+                pg["state"] = PGState.CREATED
+            self._sched_backoff.pop(key, None)
+            self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.CREATED})
+            return
+        place_view = view
+        if strategy == "STRICT_SPREAD" and locations:
+            survivors = set(locations.values())
+            place_view = {
+                nid: n for nid, n in view.items() if nid not in survivors
+            }
+        sub = scheduling.place_bundles(
+            place_view, [bundles[i] for i in missing], strategy
+        )
+        if sub is None:
+            self._sched_retry(("pg", pg_id), key)
+            return
+        placement = {missing[pos]: nid for pos, nid in sub.items()}
+        # Phase 1: PREPARE on every involved agent.
+        by_node: Dict[str, List[int]] = {}
+        for idx, node_id in placement.items():
+            by_node.setdefault(node_id, []).append(idx)
+        ok = True
+        for node_id, idxs in by_node.items():
+            addr = view[node_id]["address"]
+            try:
+                res = self._agents.get(addr).call(
+                    "prepare_bundles", pg_id=pg_id,
+                    bundles={i: bundles[i] for i in idxs},
+                )
+            except RpcError:
+                res = False
+            if not res:
+                ok = False
+                break
+        if not ok:
+            # Roll back EVERY node in the attempted placement (by its
+            # attempted indices), not just the ones that acked prepare:
+            # a node whose prepare reply was lost may still hold the
+            # reservation, and return_bundles on a node that never
+            # prepared those indices is a no-op. Synchronous call so a
+            # retried placement can't race its own rollback.
+            self._rollback_bundles(view, by_node, pg_id)
+            self._sched_retry(("pg", pg_id), key)
+            return
+        # Phase 2: COMMIT. A node that misses COMMIT would refuse
+        # bundle leases forever (raylet requires state=="committed"),
+        # so any commit failure rolls this placement back and retries.
+        commit_ok = True
+        for node_id, idxs in by_node.items():
+            try:
+                res = self._agents.get(view[node_id]["address"]).call(
+                    "commit_bundles", pg_id=pg_id
+                )
+            except RpcError:
+                res = False
+            if not res:
+                logger.warning("pg %s commit failed on %s", pg_id[:8], node_id[:8])
+                commit_ok = False
+        if not commit_ok:
+            self._rollback_bundles(view, by_node, pg_id)
+            self._sched_retry(("pg", pg_id), key)
+            return
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None:
+                return False
+            pg["bundle_locations"].update(placement)
+        # go around once more: recompute missing (usually empty -> CREATED)
+        return True
 
     def _rollback_bundles(
         self, view, by_node: Dict[str, List[int]], pg_id: str

@@ -94,6 +94,9 @@ class NodeAgent:
         # demand signal, carried on heartbeats — reference: resource_load
         # in the syncer's node snapshots)
         self._pending_leases = 0
+        # resource shapes recently starved for (shape key -> last seen):
+        # heartbeats report entries younger than the TTL
+        self._starved_shapes: Dict[tuple, float] = {}
 
         self.temp_dir = temp_dir or os.path.join(
             config.temp_dir, f"session_{session_id[:8]}"
@@ -163,11 +166,17 @@ class NodeAgent:
                 avail = dict(self.resources_available)
                 pending = self._pending_leases
                 busy = len(self._leases)
+                now = time.monotonic()
+                for k, ts in list(self._starved_shapes.items()):
+                    if now - ts > 5.0:
+                        del self._starved_shapes[k]
+                shapes = [dict(k) for k in self._starved_shapes]
             try:
                 reply = self._control.call(
                     "heartbeat", node_id=self.node_id.hex(),
                     resources_available=avail, timeout_s=5.0,
                     pending_leases=pending, active_leases=busy,
+                    extra={"pending_shapes": shapes},
                 )
                 if not reply.get("ok"):
                     # Declared dead by the control plane: our actors may
@@ -349,6 +358,14 @@ class NodeAgent:
             if target is not None and target["node_id"] != self.node_id.hex():
                 return {"granted": False, "spillback": target["address"]}
             if target is None and not self._feasible_locally(resources):
+                # No live node's TOTALS fit: surface the error to the
+                # caller fast, but record the shape so the autoscaler can
+                # report truly-infeasible demand in `rt status`
+                with self._lock:
+                    shape_key = tuple(
+                        sorted((k, float(v)) for k, v in resources.items())
+                    )
+                    self._starved_shapes[shape_key] = time.monotonic()
                 return {"granted": False, "error": "infeasible"}
         else:
             # Bundle pinned to a PG: if this node doesn't host the
@@ -390,6 +407,14 @@ class NodeAgent:
                     # serve those): the autoscaler's demand signal.
                     starved = True
                     self._pending_leases += 1
+                    # sticky per-SHAPE record: zero-wait scheduler retries
+                    # make the counter flicker faster than heartbeats
+                    # sample, but the shape entry survives (TTL-reported)
+                    # so the autoscaler can bin-pack real demand
+                    shape_key = tuple(
+                        sorted((k, float(v)) for k, v in resources.items())
+                    )
+                    self._starved_shapes[shape_key] = time.monotonic()
                 if ok:
                     worker = self._pop_idle_worker_locked(kind)
                     if worker is not None:
@@ -409,14 +434,32 @@ class NodeAgent:
                         }
                     # Resources ok but no idle worker: undo the allocation,
                     # ensure a spawn is in flight for this request, wait.
+                    # Capacity cap: short zero-wait lease retries (the
+                    # control-store scheduler queue) must not each spawn
+                    # their own worker — the pool never needs more workers
+                    # of a kind than the node can concurrently lease.
                     self._deallocate_locked(resources, resolved_bundle)
                     if not spawned_for_me:
                         spawned_for_me = True
-                        self._lock.release()
-                        try:
-                            self._spawn_worker(kind)
-                        finally:
-                            self._lock.acquire()
+                        res_key = "TPU" if kind == "tpu" else "CPU"
+                        cap = max(1, int(self.resources_total.get(res_key, 1)))
+                        n_kind = sum(
+                            1 for w in self._workers.values()
+                            if w.kind == kind and w.state != "dead"
+                        )
+                        # pending_spawns == 0 always allows a spawn: the
+                        # demand DID fit the resources (ok was True), so
+                        # zero/fractional-CPU requests past the capacity
+                        # cap must still make progress — the cap only
+                        # throttles CONCURRENT spawns from retry storms
+                        if self._pending_spawns == 0 or (
+                            n_kind + self._pending_spawns < cap
+                        ):
+                            self._lock.release()
+                            try:
+                                self._spawn_worker(kind)
+                            finally:
+                                self._lock.acquire()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return {"granted": False, "error": "lease timeout"}

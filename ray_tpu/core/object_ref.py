@@ -145,25 +145,54 @@ class ObjectRefGenerator:
         import time as _time
 
         from ray_tpu.core import object_store as os_mod
-        from ray_tpu.core.exceptions import GetTimeoutError
+        from ray_tpu.core.exceptions import GetTimeoutError, ObjectLostError
+        from ray_tpu.utils.config import config
         from ray_tpu.utils.ids import ObjectID
 
         w = self._worker
         oid = ObjectID.from_task(self._task_id, self._i)
         done_oid = w._stream_done_oid(self._task_id)
         deadline = None if timeout_s is None else _time.monotonic() + timeout_s
+        lost_deadline = None
+        err_deadline = None
         while True:
+            # Consult the final COUNT before yielding: a retried task can
+            # leave stale items from the failed attempt at indices past
+            # the final count — those must not be yielded. An Exception
+            # marker, by contrast, raises only after the present prefix of
+            # items has been consumed (they were validly produced).
+            marker = w.memory_store.try_get(done_oid)
+            has_marker = not os_mod.is_missing(marker)
+            is_err = has_marker and isinstance(marker, Exception)
+            if has_marker and not is_err and self._i >= int(marker):
+                raise StopIteration
             if w.memory_store.contains(oid):
                 self._i += 1
                 return ObjectRef(oid, w.address)
-            marker = w.memory_store.try_get(done_oid)
-            if not os_mod.is_missing(marker):
-                if isinstance(marker, Exception):
+            if is_err:
+                # the error reply rides a different connection than the
+                # in-order item pushes and can overtake them: give items
+                # yielded before the failure a short grace to land
+                if err_deadline is None:
+                    err_deadline = _time.monotonic() + 0.25
+                elif _time.monotonic() > err_deadline:
                     raise marker
-                if self._i >= int(marker):
-                    raise StopIteration
+            if has_marker and not is_err:
                 # count says item i exists but its push is still in
-                # flight on another connection: keep waiting
+                # flight on another connection: give it a bounded grace —
+                # the push can be silently lost (executor->owner link died
+                # after the count reply landed), and an unbounded wait
+                # would spin forever.
+                if lost_deadline is None:
+                    lost_deadline = (
+                        _time.monotonic() + config.stream_item_grace_s
+                    )
+                elif _time.monotonic() > lost_deadline:
+                    raise ObjectLostError(
+                        f"streamed item {self._i} of task "
+                        f"{self._task_id.hex()} was yielded but its value "
+                        "never arrived (push lost)"
+                    )
             if deadline is not None and _time.monotonic() > deadline:
                 raise GetTimeoutError(
                     f"streamed item {self._i} of task "

@@ -163,11 +163,17 @@ class ShmObjectStore:
     def _restore_locked(self, oid_hex: str, e: _Entry) -> None:
         """Bring a spilled segment back into shm (for same-host mmap).
         Called with the lock held; releases it for the byte copy."""
-        while e.state in ("spilling", "restoring"):
-            self._sealed_cv.wait(1.0)  # another thread is moving it
-        if e.state == "shm":
-            return
-        self._ensure_room_locked(e.size)
+        while True:
+            while e.state in ("spilling", "restoring"):
+                self._sealed_cv.wait(1.0)  # another thread is moving it
+            if e.state == "shm":
+                return
+            self._ensure_room_locked(e.size)
+            # _ensure_room_locked may have released the lock to spill
+            # victims; another reader can have claimed (or completed) this
+            # restore meanwhile — only one thread may claim it.
+            if e.state == "spilled":
+                break
         e.state = "restoring"
         self._used += e.size  # reserve before dropping the lock
         self._lock.release()
@@ -429,11 +435,49 @@ class MemoryStore:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._values: Dict[ObjectID, Any] = {}
+        # external wakeups: events set on every arrival (worker.wait's
+        # event-driven path registers here instead of polling)
+        self._watchers: set = set()
 
     def put(self, oid: ObjectID, value: Any) -> None:
         with self._lock:
             self._values[oid] = value
             self._cv.notify_all()
+            watchers = list(self._watchers)
+        for evt in watchers:
+            evt.set()
+
+    def add_watcher(self, evt) -> None:
+        with self._lock:
+            self._watchers.add(evt)
+
+    def remove_watcher(self, evt) -> None:
+        with self._lock:
+            self._watchers.discard(evt)
+
+    def count_present(self, oids) -> int:
+        with self._lock:
+            return sum(1 for o in oids if o in self._values)
+
+    def wait_newly_present(
+        self, oids, known_present: int, timeout_s: Optional[float]
+    ):
+        """Block until MORE of ``oids`` are present than ``known_present``
+        (or timeout); return the present subset. The event-driven core of
+        wait(): arrivals notify the condition, no polling."""
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                present = [o for o in oids if o in self._values]
+                if len(present) > known_present:
+                    return present
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return present
+                    self._cv.wait(min(remaining, 1.0))
+                else:
+                    self._cv.wait(1.0)
 
     def contains(self, oid: ObjectID) -> bool:
         with self._lock:

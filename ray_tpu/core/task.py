@@ -30,6 +30,7 @@ class TaskOptions:
     scheduling_strategy: Any = None  # see core.scheduling docstring
     name: Optional[str] = None
     runtime_env: Optional[Dict[str, Any]] = None
+    tensor_transport: str = "object"  # "device" → TPU-RDT returns
 
     def resource_demand(self, default_cpus: float = 1.0) -> Dict[str, float]:
         demand = dict(self.resources)
@@ -50,6 +51,10 @@ def _merge_options(base: TaskOptions, **overrides) -> TaskOptions:
             k = "num_tpus"
         if k == "num_returns" and v == "streaming":
             v = -1  # wire sentinel for dynamic return count
+        if k == "tensor_transport":
+            from ray_tpu.core.device_objects import validate_transport
+
+            validate_transport(v)
         if not hasattr(merged, k):
             raise TypeError(f"unknown option {k!r}")
         setattr(merged, k, v)
@@ -122,3 +127,7 @@ class TaskSpec:
     # actor fields
     actor_id: Optional[str] = None
     method_name: Optional[str] = None
+    # "object" (default) or "device": device-resident returns (TPU-RDT,
+    # core/device_objects.py) — jax.Array leaves stay in the executor's
+    # HBM; only metadata travels in the reply.
+    tensor_transport: str = "object"

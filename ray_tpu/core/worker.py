@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import object_store as os_mod
 from ray_tpu.core import runtime_env as runtime_env_mod
+from ray_tpu.core.device_objects import DeviceValue
 from collections import OrderedDict, deque
 
 from ray_tpu.core.exceptions import (
@@ -93,6 +94,15 @@ class ReferenceTracker:
     in-flight pins therefore carry a TTL (config.borrow_pin_ttl_s) and are
     swept opportunistically on tracker activity — the lightweight stand-in
     for the reference's task-completion borrow reports.
+
+    Args of still-pending tasks are additionally guarded by a
+    TASK-PENDENCY BORROW (the reference achieves this with
+    task-completion borrow reports, reference_counter.h:44): when packing
+    a task's args the submitter takes one plain borrow per serialized ref
+    and releases it when the task reaches a terminal state. Unlike the
+    in-flight token (consumed by the first deserialization), the pendency
+    borrow survives retries — a ref arg stays alive across a lease-queue
+    wait longer than the TTL AND between attempts of a retried task.
     """
 
     def __init__(self, worker: "CoreWorker"):
@@ -102,6 +112,9 @@ class ReferenceTracker:
         self._borrows: Dict[ObjectID, int] = {}  # owner side: remote borrowers
         # owner side: in-flight pins, token -> (oid, created_at monotonic)
         self._escape_tokens: Dict[str, Tuple[ObjectID, float]] = {}
+        # serializer side: per-thread capture of refs serialized while
+        # packing task args (worker._pack_task_args)
+        self._capture = threading.local()
         self._next_sweep = 0.0
         # Tokens whose consume arrived before their register (one-way RPCs
         # on different sockets have no cross-connection ordering): a later
@@ -141,7 +154,11 @@ class ReferenceTracker:
     def on_serialize(self, ref: ObjectRef, token: str) -> None:
         """A ref is crossing a process boundary: pin the object at the
         owner for the duration of the flight, keyed by token."""
-        if self._worker.owns(ref):
+        owned = self._worker.owns(ref)
+        items = getattr(self._capture, "items", None)
+        if items is not None:
+            items.append((ref.owner_address, ref.id, owned))
+        if owned:
             with self._lock:
                 self._escape_tokens[token] = (ref.id, time.monotonic())
                 self._borrows[ref.id] = self._borrows.get(ref.id, 0) + 1
@@ -150,6 +167,22 @@ class ReferenceTracker:
             self._worker.send_add_borrow(
                 ref.owner_address, ref.id, register_token=token
             )
+
+    def begin_capture(self) -> None:
+        """Start recording refs serialized by on_serialize on this thread."""
+        self._capture.items = []
+
+    def end_capture(self) -> List[Tuple[str, ObjectID, bool]]:
+        """Stop recording; return [(owner_address, oid, owned)]."""
+        items = getattr(self._capture, "items", None) or []
+        self._capture.items = None
+        return items
+
+    def add_task_borrow(self, oid: ObjectID) -> None:
+        """Owner-side pendency borrow: keep an owned ref arg alive while
+        its task is pending (released via owner_release_borrow)."""
+        with self._lock:
+            self._borrows[oid] = self._borrows.get(oid, 0) + 1
 
     def on_deserialize(self, ref: ObjectRef, token: Optional[str]) -> None:
         """A ref arrived from another process; take over its in-flight pin
@@ -313,6 +346,10 @@ class CoreWorker:
 
         self.memory_store = MemoryStore()
         self.shm = ShmClient()
+        # TPU-RDT: lazily-built store of device-resident pytrees this
+        # process produced under tensor_transport="device"
+        self._device_store = None
+        self._device_store_lock = threading.Lock()
         self.reference_tracker = ReferenceTracker(self)
 
         self.job_id = job_id or JobID.nil()
@@ -326,6 +363,14 @@ class CoreWorker:
         self._submit_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="submit"
         )
+        # Owner-side task dependency resolution (reference
+        # local_dependency_resolver.h): started lazily on the first task
+        # submitted with a pending ObjectRef arg.
+        self._dep_resolver: Optional[_DependencyResolver] = None
+        self._dep_resolver_lock = threading.Lock()
+        # actor tasks: task_id hex -> pending top-level ObjectRef args,
+        # awaited by the actor sender thread before the send
+        self._pending_task_deps: Dict[str, List[ObjectRef]] = {}
         # per-actor ordered senders + address cache
         self._actor_senders: Dict[str, "_ActorSender"] = {}
         self._actor_senders_lock = threading.Lock()
@@ -340,6 +385,13 @@ class CoreWorker:
         self._cancelled_tasks: set = set()
         # owner side: task_id hex -> worker address currently executing it
         self._inflight_push: Dict[str, str] = {}
+        # submitter side: task_id hex -> [(owner_address, ObjectID, owned)]
+        # pendency borrows protecting the task's serialized args until it
+        # reaches a terminal state
+        self._arg_pins: Dict[str, List[Tuple[str, ObjectID, bool]]] = {}
+        # actors whose init-arg borrows must outlive the first ALIVE
+        # observation (max_restarts != 0: restarts re-read the init args)
+        self._restartable_actor_inits: set = set()
         self._reattach_lock = threading.Lock()
         # lineage (reference object_recovery_manager.h:26 + task_manager.h
         # lineage bookkeeping): task_id hex -> [spec, strategy,
@@ -488,15 +540,60 @@ class CoreWorker:
     # put / get / wait / free (reference core_worker.h:486,662,702)
     # ------------------------------------------------------------------
 
-    def put(self, value: Any) -> ObjectRef:
+    def put(self, value: Any, tensor_transport: str = "object") -> ObjectRef:
         with self._task_index_lock:
             self._put_index += 1
             idx = self._put_index
         task_id = self.current_task_id() or TaskID.for_driver(self.current_job_id())
         oid = ObjectID.from_task(task_id, 2**31 + idx)
+        if tensor_transport == "device":
+            parts = self.device_store.put(oid.hex(), value)
+            if parts is not None:
+                skeleton, leaves_meta = parts
+                self.memory_store.put(
+                    oid,
+                    DeviceValue(self.address, oid.hex(), skeleton, leaves_meta),
+                )
+                return ObjectRef(oid, self.address)
+            # no device arrays inside: fall through to the object path
         frame = serialization.pack(value)
         self._store_frame_maybe_plasma(oid, frame)
         return ObjectRef(oid, self.address)
+
+    @property
+    def device_store(self):
+        """TPU-RDT device object store (lazy: imports jax machinery only
+        when tensor_transport='device' is actually used)."""
+        with self._device_store_lock:
+            if self._device_store is None:
+                from ray_tpu.core.device_objects import DeviceObjectStore
+
+                self._device_store = DeviceObjectStore()
+            return self._device_store
+
+    def _fetch_device_value(self, dv) -> Any:
+        """Materialize a DeviceValue: zero-copy when this process holds
+        the payload; raw-buffer pull + device_put otherwise."""
+        from ray_tpu.core import device_objects as dev_mod
+
+        if dv.worker_address == self.address:
+            return self.device_store.get_value(dv.obj_hex)
+        client = self.workers.get(dv.worker_address)
+        try:
+            raw = client.call(
+                "fetch_device_object", obj_hex=dv.obj_hex, timeout_s=600.0
+            )
+        except RpcConnectionError as e:
+            raise ObjectLostError(
+                f"device object {dv.obj_hex[:16]} lost: holder "
+                f"{dv.worker_address} unreachable ({e})"
+            ) from None
+        if raw is None:
+            raise ObjectLostError(
+                f"device object {dv.obj_hex[:16]} was freed at the holder"
+            )
+        arrays = dev_mod.materialize_leaves(dv.leaves_meta, raw)
+        return dev_mod.join_device_value(dv.skeleton, arrays)
 
     def _store_frame_maybe_plasma(self, oid: ObjectID, frame: bytes) -> None:
         if len(frame) > config.max_direct_call_object_size:
@@ -591,6 +688,8 @@ class CoreWorker:
                 return serialization.unpack(data)
             view = self._read_local_segment(stored.path, stored.size)
             return serialization.unpack(view)
+        if isinstance(stored, DeviceValue):
+            return self._fetch_device_value(stored)
         if isinstance(stored, TaskError):
             raise stored
         if isinstance(stored, LostValue):
@@ -614,6 +713,12 @@ class CoreWorker:
             path, size, agent_address = payload
             data = self._pull_remote_segment(path, size, agent_address)
             return serialization.unpack(data)
+        if kind == "device":
+            addr, skeleton, leaves_meta = payload[:3]
+            obj_hex = payload[3]
+            return self._fetch_device_value(
+                DeviceValue(addr, obj_hex, skeleton, leaves_meta)
+            )
         if kind == "error":
             raise payload
         raise RuntimeError(f"unexpected get_object reply kind {kind}")
@@ -699,48 +804,116 @@ class CoreWorker:
         fetch_local: bool = True,
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
-        pending = list(refs)
-        ready: List[ObjectRef] = []
-        while True:
-            ready_now = self._poll_ready(pending)
-            still = [r for r in pending if r not in ready_now]
-            ready.extend(r for r in pending if r in ready_now)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            time.sleep(0.02)
-        return ready, pending
-
-    def _poll_ready(self, refs: List[ObjectRef]) -> set:
-        """One batched readiness probe per owner (not per ref per tick)."""
-        ready: set = set()
-        by_owner: Dict[str, List[ObjectRef]] = {}
-        for ref in refs:
-            if self.owns(ref):
-                if self.memory_store.contains(ref.id):
-                    ready.add(ref)
-            else:
-                by_owner.setdefault(ref.owner_address, []).append(ref)
-        for owner, group in by_owner.items():
-            try:
-                states = self.workers.get(owner).call(
-                    "peek_objects", oid_hexes=[r.id.hex() for r in group],
-                    timeout_s=10.0,
+        local = [r for r in refs if self.owns(r)]
+        remote = [r for r in refs if not self.owns(r)]
+        if not remote:
+            # Fully event-driven: block on the memory store's condition —
+            # an arriving object wakes the waiter immediately (reference
+            # wait is likewise future-driven, core_worker.h:702; the
+            # round-3 20 ms poll tick is gone).
+            known = -1
+            while True:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
                 )
-                for r, ok in zip(group, states):
-                    if ok:
-                        ready.add(r)
-            except RpcConnectionError:
-                # owner actually unreachable: surfacing the error counts as
-                # ready (get() will raise OwnerDiedError)
-                ready.update(group)
-            except RpcError:
-                # transient (e.g. RpcTimeout under load): leave pending and
-                # probe again next tick
-                pass
-        return ready
+                present = self.memory_store.wait_newly_present(
+                    [r.id for r in local], known, remaining
+                )
+                ready = [r for r in local if r.id in set(present)]
+                if len(ready) >= num_returns or len(ready) == len(local):
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                known = len(present)
+            ready_set = set(ready)
+            return ready, [r for r in refs if r not in ready_set]
+        return self._wait_mixed(refs, num_returns, deadline)
+
+    def _wait_mixed(self, refs, num_returns, deadline):
+        """wait() over refs owned (partly) by other workers: one BLOCKING
+        wait_objects RPC per owner (async, completion sets the event) plus
+        a memory-store watcher for locally-owned arrivals — event-driven
+        end to end, no poll tick."""
+        evt = threading.Event()
+        self.memory_store.add_watcher(evt)
+        inflight: Dict[str, bool] = {}
+        replies: Dict[str, set] = {}
+        lost: set = set()
+        try:
+            while True:
+                # clear BEFORE recomputing: a completion landing between
+                # the scan and the wait must not be lost
+                evt.clear()
+                ready: List[ObjectRef] = []
+                pending: List[ObjectRef] = []
+                by_owner: Dict[str, List[ObjectRef]] = {}
+                for r in refs:
+                    if self.owns(r):
+                        if self.memory_store.contains(r.id):
+                            ready.append(r)
+                        else:
+                            pending.append(r)
+                    elif r.id.hex() in replies.get(r.owner_address, ()):
+                        ready.append(r)
+                    elif r.owner_address in lost:
+                        # owner unreachable: surfacing the error counts as
+                        # ready (get() will raise OwnerDiedError)
+                        ready.append(r)
+                    else:
+                        pending.append(r)
+                        by_owner.setdefault(r.owner_address, []).append(r)
+                if len(ready) >= num_returns or not pending:
+                    return ready, pending
+                if deadline is not None and time.monotonic() >= deadline:
+                    return ready, pending
+                remaining = (
+                    30.0 if deadline is None
+                    else min(30.0, max(0.05, deadline - time.monotonic()))
+                )
+                for owner, group in by_owner.items():
+                    if inflight.get(owner):
+                        continue
+                    inflight[owner] = True
+                    # the group holds only still-pending oids, none of
+                    # which we know to be present — any arrival counts
+                    known = 0
+
+                    def _done(p, owner=owner):
+                        inflight[owner] = False
+                        try:
+                            present = p.wait(0)
+                            replies.setdefault(owner, set()).update(present)
+                        except RpcConnectionError:
+                            lost.add(owner)
+                        except RpcError:
+                            pass
+                        evt.set()
+
+                    try:
+                        pend = self.workers.get(owner).call_async(
+                            "wait_objects",
+                            oid_hexes=[r.id.hex() for r in group],
+                            known_present=known, wait_s=remaining,
+                        )
+                        pend.add_done_callback(_done)
+                    except RpcConnectionError:
+                        lost.add(owner)
+                        inflight[owner] = False
+                evt.wait(remaining)
+        finally:
+            self.memory_store.remove_watcher(evt)
+
+    def rpc_wait_objects(
+        self, conn, oid_hexes: List[str], known_present: int = -1,
+        wait_s: float = 30.0,
+    ):
+        """Owner side of event-driven wait: block until more of the oids
+        are present than the waiter already knows about."""
+        oids = [ObjectID.from_hex(h) for h in oid_hexes]
+        present = self.memory_store.wait_newly_present(
+            oids, known_present, min(wait_s, 120.0)
+        )
+        return [o.hex() for o in present]
 
     def free(self, refs: List[ObjectRef]) -> None:
         for ref in refs:
@@ -765,6 +938,16 @@ class CoreWorker:
                 )
             except RpcError:
                 pass
+        elif isinstance(stored, DeviceValue):
+            if stored.worker_address == self.address:
+                self.device_store.free(stored.obj_hex)
+            else:
+                try:
+                    self.workers.get(stored.worker_address).call_oneway(
+                        "free_device_object", obj_hex=stored.obj_hex
+                    )
+                except RpcError:
+                    pass
 
     def send_add_borrow(
         self,
@@ -791,6 +974,41 @@ class CoreWorker:
         except RpcError:
             pass
 
+    def _pack_task_args(self, payload, task_hex: str) -> bytes:
+        """Pack task args, taking a pendency borrow on every ObjectRef
+        serialized inside — held until the task reaches a terminal state
+        (_release_arg_pins). Unlike the in-flight serialization pin
+        (consumed by the first deserialization), the pendency borrow
+        survives long lease-queue waits AND retries. Reference parity:
+        borrow reports keep task-arg refs alive for the task's whole
+        pendency (reference_counter.h:44)."""
+        tr = self.reference_tracker
+        tr.begin_capture()
+        try:
+            frame = serialization.pack(payload)
+        finally:
+            pins = tr.end_capture()
+        if pins:
+            self._arg_pins[task_hex] = pins
+            for addr, oid, owned in pins:
+                if owned:
+                    tr.add_task_borrow(oid)
+                else:
+                    self.send_add_borrow(addr, oid)
+        return frame
+
+    def _release_arg_pins(self, task_hex: str) -> None:
+        """Task reached a terminal state: drop its args' pendency borrows."""
+        pins = self._arg_pins.pop(task_hex, None)
+        if not pins:
+            return
+        tr = self.reference_tracker
+        for addr, oid, owned in pins:
+            if owned:
+                tr.owner_release_borrow(oid)
+            else:
+                self.send_release_borrow(addr, oid)
+
     # ------------------------------------------------------------------
     # normal task submission (reference normal_task_submitter.h:124)
     # ------------------------------------------------------------------
@@ -806,11 +1024,15 @@ class CoreWorker:
                 ObjectRef(ObjectID.from_task(task_id, i), self.address)
                 for i in range(options.num_returns)
             ]
+        # Anything that can raise resolves BEFORE packing the args: packing
+        # takes pendency borrows that only terminal task states release.
+        strategy = self._resolve_strategy(options.scheduling_strategy)
+        runtime_env = runtime_env_mod.prepare(options.runtime_env, self.control)
         spec = TaskSpec(
             task_id=task_id,
             fn_id=fn_id,
             fn_name=fn_name,
-            args_frame=serialization.pack((args, kwargs)),
+            args_frame=self._pack_task_args((args, kwargs), task_id.hex()),
             num_returns=options.num_returns,
             owner_address=self.address,
             resources=options.resource_demand(default_cpus=1.0),
@@ -821,11 +1043,9 @@ class CoreWorker:
             ),
             retry_exceptions=options.retry_exceptions,
             name=options.name or fn_name,
-            runtime_env=runtime_env_mod.prepare(
-                options.runtime_env, self.control
-            ),
+            runtime_env=runtime_env,
+            tensor_transport=options.tensor_transport or "object",
         )
-        strategy = self._resolve_strategy(options.scheduling_strategy)
         with self._lineage_lock:
             self._lineage[task_id.hex()] = [spec, strategy, options.num_returns]
             self._lineage_bytes += len(spec.args_frame)
@@ -835,8 +1055,47 @@ class CoreWorker:
             ):
                 _, dropped = self._lineage.popitem(last=False)
                 self._lineage_bytes -= len(dropped[0].args_frame)
-        self._submit_pool.submit(self._submit_normal_task, spec, strategy)
+        pending_deps = self._pending_arg_deps(args, kwargs)
+        if pending_deps:
+            # The task must not compete for a worker lease until every
+            # top-level ObjectRef arg is available — an executor blocking
+            # on an upstream producer while HOLDING a leased CPU starves
+            # the producers themselves (shuffle reduce-before-map
+            # deadlock). Reference: local_dependency_resolver.h.
+            self.dep_resolver.add(
+                pending_deps,
+                lambda: self._submit_pool.submit(
+                    self._submit_normal_task, spec, strategy
+                ),
+            )
+        else:
+            self._submit_pool.submit(self._submit_normal_task, spec, strategy)
         return refs
+
+    def _pending_arg_deps(self, args, kwargs) -> List[ObjectRef]:
+        """Top-level ObjectRef args not yet known to be available (Ray
+        semantics: only top-level refs are task dependencies; nested refs
+        pass through un-awaited)."""
+        deps = [a for a in args if isinstance(a, ObjectRef)]
+        deps.extend(v for v in kwargs.values() if isinstance(v, ObjectRef))
+        pending, seen = [], set()
+        for r in deps:
+            if r.id in seen:
+                continue
+            seen.add(r.id)
+            if self.owns(r):
+                if not self.memory_store.contains(r.id):
+                    pending.append(r)
+            else:
+                pending.append(r)  # resolver confirms with the owner
+        return pending
+
+    @property
+    def dep_resolver(self) -> "_DependencyResolver":
+        with self._dep_resolver_lock:
+            if self._dep_resolver is None:
+                self._dep_resolver = _DependencyResolver(self)
+            return self._dep_resolver
 
     def _drop_lineage_return(self, oid: ObjectID) -> None:
         """An owned object was deleted: its task's lineage entry loses a
@@ -857,6 +1116,14 @@ class CoreWorker:
         re-execute (a reconstruction over a live value would race the
         existing segment)."""
         stored = self.memory_store.try_get(oid)
+        if isinstance(stored, DeviceValue):
+            try:
+                return not self.workers.get(stored.worker_address).call(
+                    "device_object_contains", obj_hex=stored.obj_hex,
+                    timeout_s=5.0,
+                )
+            except RpcError:
+                return True  # holder unreachable: device payload is gone
         if not isinstance(stored, PlasmaValue):
             return not os_mod.is_missing(stored) and isinstance(
                 stored, LostValue
@@ -1068,6 +1335,25 @@ class CoreWorker:
             reply = client.call("push_task", spec=spec, timeout_s=86400.0 * 30)
             self._store_task_reply(spec, reply)
         except (RpcConnectionError, RpcTimeout):
+            if spec.tensor_transport == "device":
+                # The executor may have finished and parked device-resident
+                # returns before the reply was lost; a retry lands on a new
+                # worker, so free any HBM the (possibly still-alive) first
+                # executor pinned for this task. Best-effort on the
+                # EXISTING connection only — reconnecting to a dead worker
+                # would stall the retry path for rpc_connect_timeout_s.
+                try:
+                    c = self.workers.get(worker_addr)
+                    if c._sock is not None:
+                        for i in range(max(spec.num_returns, 0)):
+                            c.call_oneway(
+                                "free_device_object",
+                                obj_hex=ObjectID.from_task(
+                                    spec.task_id, i
+                                ).hex(),
+                            )
+                except RpcError:
+                    pass
             self.workers.drop(worker_addr)
             kill = True
             raise WorkerCrashedError(
@@ -1083,10 +1369,32 @@ class CoreWorker:
     def _stream_done_oid(self, task_id: TaskID) -> ObjectID:
         return ObjectID.from_task(task_id, self._STREAM_DONE_INDEX)
 
+    def _drop_stale_stream_items(self, spec: TaskSpec, count: int) -> None:
+        """A retried streaming task can leave items from a longer failed
+        attempt at indices >= the final count; the generator (correctly)
+        never yields them, so free them here lest they leak. Items are
+        pushed in order, so stale ones sit contiguously from `count`."""
+        idx = count
+        while idx < count + 100000:  # safety bound
+            oid = ObjectID.from_task(spec.task_id, idx)
+            stored = self.memory_store.try_get(oid)
+            if os_mod.is_missing(stored):
+                break
+            self.memory_store.delete(oid)
+            if isinstance(stored, PlasmaValue):
+                try:
+                    self.agents.get(stored.agent_address).call_oneway(
+                        "delete_objects", oid_hexes=[oid.hex()]
+                    )
+                except RpcError:
+                    pass
+            idx += 1
+
     def _store_error_returns(self, spec: TaskSpec, err: Exception) -> None:
         """Fail every return slot. Streaming tasks (num_returns == -1)
         have no fixed slots: the error lands in the done-marker, which the
         ObjectRefGenerator raises when it reaches it."""
+        self._release_arg_pins(spec.task_id.hex())
         if spec.num_returns == -1:
             self.memory_store.put(self._stream_done_oid(spec.task_id), err)
             return
@@ -1106,12 +1414,18 @@ class CoreWorker:
         return True
 
     def _store_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        if reply["status"] != "error" or not spec.retry_exceptions:
+            # terminal (the retry_exceptions error path re-raises to the
+            # retry loop: the task is still pending, so its args keep
+            # their pendency borrows for the next attempt)
+            self._release_arg_pins(spec.task_id.hex())
         if reply["status"] == "ok" and spec.num_returns == -1:
             # streaming: items arrived via rpc_stream_item pushes (possibly
             # still in flight on another connection — the generator waits
             # for item i even after seeing the count); store the count
             count = reply["returns"][0][1]
             self.memory_store.put(self._stream_done_oid(spec.task_id), count)
+            self._drop_stale_stream_items(spec, int(count))
             return
         if reply["status"] == "ok":
             for oid_hex, (kind, payload) in reply["returns"]:
@@ -1121,6 +1435,11 @@ class CoreWorker:
                 elif kind == "plasma":
                     path, size, agent_addr = payload
                     self.memory_store.put(oid, PlasmaValue(path, size, agent_addr))
+                elif kind == "device":
+                    addr, skeleton, leaves_meta = payload
+                    self.memory_store.put(
+                        oid, DeviceValue(addr, oid_hex, skeleton, leaves_meta)
+                    )
                 if self.reference_tracker.maybe_delete_unreferenced(oid):
                     # every ref (and borrow) died while the task was running
                     self.delete_owned_object(oid)
@@ -1141,12 +1460,27 @@ class CoreWorker:
                      actor_options) -> str:
         actor_id = ActorID.of(self.current_job_id()).hex()
         self.register_function(class_id, class_blob, class_name)
+        # resolve fallible inputs before packing (packing takes pendency
+        # borrows that need a terminal event to release)
+        strategy = self._resolve_strategy(
+            actor_options.get("scheduling_strategy")
+        )
+        runtime_env = runtime_env_mod.prepare(
+            actor_options.get("runtime_env"), self.control
+        )
         spec = {
             "actor_id": actor_id,
             "job_id": self.current_job_id().hex(),
             "class_id": class_id,
             "class_name": class_name,
-            "init_args_frame": serialization.pack((init_args, init_kwargs)),
+            # actor-creation args can wait arbitrarily long in PG queues;
+            # the pendency borrows are released when the creator first
+            # observes the actor ALIVE or DEAD (_resolve_actor_address) —
+            # an actor the creator never interacts with keeps them until
+            # process exit, which is the semantics of holding the handle
+            "init_args_frame": self._pack_task_args(
+                (init_args, init_kwargs), f"actor_init_{actor_id}"
+            ),
             "resources": actor_options.get("resources", {}),
             "name": actor_options.get("name"),
             "namespace": actor_options.get("namespace", "default"),
@@ -1155,15 +1489,20 @@ class CoreWorker:
             "max_task_retries": actor_options.get("max_task_retries", 0),
             "max_concurrency": actor_options.get("max_concurrency", 1),
             "method_names": actor_options.get("method_names", []),
-            "scheduling_strategy": self._resolve_strategy(
-                actor_options.get("scheduling_strategy")
-            ),
-            "runtime_env": runtime_env_mod.prepare(
-                actor_options.get("runtime_env"), self.control
-            ),
+            "scheduling_strategy": strategy,
+            "runtime_env": runtime_env,
             "owner_address": self.address,
         }
-        self.control.call("register_actor", spec=spec, retryable=True)
+        if int(spec["max_restarts"] or 0) != 0:
+            # a restart re-deserializes init_args_frame: the pendency
+            # borrows must survive until the actor is PERMANENTLY dead
+            self._restartable_actor_inits.add(actor_id)
+        try:
+            self.control.call("register_actor", spec=spec, retryable=True)
+        except BaseException:
+            self._restartable_actor_inits.discard(actor_id)
+            self._release_arg_pins(f"actor_init_{actor_id}")
+            raise
         return actor_id
 
     def _actor_sender(self, actor_id: str) -> "_ActorSender":
@@ -1190,13 +1529,21 @@ class CoreWorker:
                 timeout_s=remaining + 30.0, retryable=True,
             )
             if info is None:
+                self._restartable_actor_inits.discard(actor_id)
+                self._release_arg_pins(f"actor_init_{actor_id}")
                 raise ActorDiedError(f"actor {actor_id} does not exist")
             if info["state"] == "DEAD":
+                self._restartable_actor_inits.discard(actor_id)
+                self._release_arg_pins(f"actor_init_{actor_id}")
                 raise ActorDiedError(
                     f"actor {actor_id} is dead: {info.get('death_cause')}"
                 )
             if info["state"] == "ALIVE" and info.get("worker_address"):
                 self._actor_addr_cache[actor_id] = info["worker_address"]
+                if actor_id not in self._restartable_actor_inits:
+                    # creation args were consumed by the actor start and a
+                    # non-restartable actor never re-reads them
+                    self._release_arg_pins(f"actor_init_{actor_id}")
                 return info["worker_address"]
             if self._shutdown.is_set() or time.monotonic() >= deadline:
                 raise ActorUnavailableError(f"actor {actor_id} is {info['state']}")
@@ -1215,7 +1562,8 @@ class CoreWorker:
         return n
 
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs,
-                          num_returns: int = 1) -> List[ObjectRef]:
+                          num_returns: int = 1,
+                          tensor_transport: str = "object") -> List[ObjectRef]:
         task_id = TaskID.for_actor_task(ActorID.from_hex(actor_id))
         refs = [
             ObjectRef(ObjectID.from_task(task_id, i), self.address)
@@ -1225,7 +1573,7 @@ class CoreWorker:
             task_id=task_id,
             fn_id="",
             fn_name=method_name,
-            args_frame=serialization.pack((args, kwargs)),
+            args_frame=self._pack_task_args((args, kwargs), task_id.hex()),
             num_returns=num_returns,
             owner_address=self.address,
             resources={},
@@ -1236,11 +1584,19 @@ class CoreWorker:
             actor_id=actor_id,
             method_name=method_name,
             name=f"{actor_id[:8]}.{method_name}",
+            tensor_transport=tensor_transport,
         )
+        pending_deps = self._pending_arg_deps(args, kwargs)
+        if pending_deps:
+            # awaited by the sender thread just before the send — ordered
+            # per-caller, so later calls queue behind as Ray's sequence
+            # numbers would
+            self._pending_task_deps[task_id.hex()] = pending_deps
         self._actor_sender(actor_id).submit(spec)
         return refs
 
     def _store_actor_task_failure(self, spec: TaskSpec, e: Exception) -> None:
+        self._release_arg_pins(spec.task_id.hex())
         if not isinstance(e, (TaskError, ActorDiedError, ActorUnavailableError)):
             e = TaskError(f"actor task {spec.name} failed: {e}", traceback.format_exc())
         for i in range(spec.num_returns):
@@ -1261,6 +1617,8 @@ class CoreWorker:
         except RpcError:
             info = None
         if info is None or info["state"] == "DEAD":
+            self._restartable_actor_inits.discard(spec.actor_id)
+            self._release_arg_pins(f"actor_init_{spec.actor_id}")
             return ActorDiedError(
                 f"actor {spec.actor_id[:8]} died: "
                 f"{info.get('death_cause') if info else 'unknown'}"
@@ -1273,6 +1631,9 @@ class CoreWorker:
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
         self.control.call("kill_actor", actor_id=actor_id, no_restart=no_restart)
         self._actor_addr_cache.pop(actor_id, None)
+        if no_restart:
+            self._restartable_actor_inits.discard(actor_id)
+            self._release_arg_pins(f"actor_init_{actor_id}")
 
     def cancel_task(self, ref: ObjectRef) -> None:
         """Best-effort cancel (reference core_worker.h Cancel): tasks not
@@ -1462,7 +1823,18 @@ class CoreWorker:
         try:
             if spec.actor_id is not None:
                 rt = self._actor_runtime
-                target = getattr(rt.instance, spec.method_name, None)
+                if spec.method_name == "__rt_dag_exec_loop__":
+                    # compiled-graph exec loop (ray_tpu/dag.py): a system
+                    # task that parks on this actor until DAG teardown
+                    import functools
+
+                    from ray_tpu import dag as dag_mod
+
+                    target = functools.partial(
+                        dag_mod._actor_exec_loop, rt.instance
+                    )
+                else:
+                    target = getattr(rt.instance, spec.method_name, None)
                 if target is None:
                     raise AttributeError(
                         f"actor has no method {spec.method_name!r}"
@@ -1531,6 +1903,16 @@ class CoreWorker:
         returns = []
         for i, value in enumerate(values):
             oid = ObjectID.from_task(spec.task_id, i)
+            if spec.tensor_transport == "device":
+                parts = self.device_store.put(oid.hex(), value)
+                if parts is not None:
+                    skeleton, leaves_meta = parts
+                    returns.append((
+                        oid.hex(),
+                        ("device", (self.address, skeleton, leaves_meta)),
+                    ))
+                    continue
+                # no device arrays in the value: ordinary object path
             frame = serialization.pack(value)
             if len(frame) > config.max_direct_call_object_size:
                 path = self.agent.call(
@@ -1603,6 +1985,12 @@ class CoreWorker:
                     (stored.path, stored.size, stored.agent_address),
                 )
             return ("plasma", (stored.path, stored.size))
+        if isinstance(stored, DeviceValue):
+            return (
+                "device",
+                (stored.worker_address, stored.skeleton, stored.leaves_meta,
+                 stored.obj_hex),
+            )
         if isinstance(stored, LostValue):
             return ("error", ObjectLostError(stored.message))
         if isinstance(stored, Exception):
@@ -1620,6 +2008,32 @@ class CoreWorker:
     def rpc_free_object(self, conn, oid_hex: str):
         self.delete_owned_object(ObjectID.from_hex(oid_hex))
         return True
+
+    def rpc_fetch_device_object(self, conn, obj_hex: str):
+        """Serve a device object's raw leaf buffers to a remote consumer
+        (device→host DMA here; host→device device_put at the consumer)."""
+        if self._device_store is None or not self._device_store.contains(obj_hex):
+            return None
+        try:
+            return self._device_store.fetch_leaves(obj_hex)
+        except KeyError:
+            return None
+
+    def rpc_device_object_contains(self, conn, obj_hex: str):
+        return (
+            self._device_store is not None
+            and self._device_store.contains(obj_hex)
+        )
+
+    def rpc_free_device_object(self, conn, obj_hex: str):
+        if self._device_store is not None:
+            self._device_store.free(obj_hex)
+        return True
+
+    def rpc_device_store_stats(self, conn):
+        if self._device_store is None:
+            return {"device_objects": 0, "device_bytes": 0}
+        return self._device_store.stats()
 
     def rpc_add_borrow(
         self, conn, oid_hex: str, register_token=None, consume_token=None
@@ -1650,6 +2064,111 @@ class CoreWorker:
 
         threading.Thread(target=_die, daemon=True).start()
         return True
+
+
+class _DependencyResolver:
+    """Owner-side task dependency resolution (reference
+    local_dependency_resolver.h): a normal task whose top-level ObjectRef
+    args are not yet available must not compete for a worker lease —
+    executors would hold leased CPUs while blocked fetching upstream
+    outputs, starving the very producer tasks they wait on (observed as
+    the shuffle reduce-before-map lease deadlock).
+
+    Event-driven: locally-owned arrivals wake the loop through a
+    memory-store watcher; deps owned by other workers resolve through
+    async wait_objects RPCs to their owners (completion re-wakes the
+    loop). An unreachable owner marks its deps resolved — the executor
+    surfaces OwnerDiedError at arg fetch, which is the reference's
+    error-propagation path too."""
+
+    def __init__(self, worker: CoreWorker):
+        self.worker = worker
+        self._lock = threading.Lock()
+        # entries: [pending deps list, ready callback]
+        self._entries: List[List] = []
+        self._remote_present: set = set()  # oid hexes confirmed at owners
+        self._owners_lost: set = set()
+        self._inflight: Dict[str, bool] = {}
+        self._evt = threading.Event()
+        worker.memory_store.add_watcher(self._evt)
+        self._thread = threading.Thread(
+            target=self._loop, name="dep-resolver", daemon=True
+        )
+        self._thread.start()
+
+    def add(self, deps: List[ObjectRef], ready_cb) -> None:
+        with self._lock:
+            self._entries.append([list(deps), ready_cb])
+        self._evt.set()
+
+    def _dep_ready(self, r: ObjectRef) -> bool:
+        w = self.worker
+        if w.owns(r):
+            return w.memory_store.contains(r.id)
+        return (
+            r.id.hex() in self._remote_present
+            or r.owner_address in self._owners_lost
+        )
+
+    def _loop(self) -> None:
+        w = self.worker
+        while not w._shutdown.is_set():
+            self._evt.wait(1.0)
+            self._evt.clear()
+            ready_cbs: List = []
+            by_owner: Dict[str, set] = {}
+            with self._lock:
+                still: List[List] = []
+                for deps, cb in self._entries:
+                    remaining = [r for r in deps if not self._dep_ready(r)]
+                    if remaining:
+                        still.append([remaining, cb])
+                        for r in remaining:
+                            if not w.owns(r):
+                                by_owner.setdefault(
+                                    r.owner_address, set()
+                                ).add(r.id.hex())
+                    else:
+                        ready_cbs.append(cb)
+                self._entries = still
+                # prune confirmations no longer referenced by any entry
+                if self._remote_present:
+                    referenced: set = set()
+                    for hexes in by_owner.values():
+                        referenced |= hexes
+                    self._remote_present &= referenced
+            for owner, hexes in by_owner.items():
+                if self._inflight.get(owner) or owner in self._owners_lost:
+                    continue
+                self._inflight[owner] = True
+
+                def _done(p, owner=owner):
+                    self._inflight[owner] = False
+                    try:
+                        present = p.wait(0)
+                        with self._lock:
+                            self._remote_present.update(present)
+                    except RpcConnectionError:
+                        self._owners_lost.add(owner)
+                    except RpcError:
+                        pass  # transient: next pass re-issues
+                    self._evt.set()
+
+                try:
+                    pend = w.workers.get(owner).call_async(
+                        "wait_objects", oid_hexes=sorted(hexes),
+                        known_present=0, wait_s=30.0,
+                    )
+                    pend.add_done_callback(_done)
+                except RpcError:
+                    self._owners_lost.add(owner)
+                    self._inflight[owner] = False
+                    self._evt.set()
+            for cb in ready_cbs:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001
+                    logger.exception("dependency-ready callback failed")
 
 
 class _ActorSender:
@@ -1714,6 +2233,18 @@ class _ActorSender:
                 spec = self.specs.get(timeout=0.5)
             except queue.Empty:
                 continue
+            deps = w._pending_task_deps.pop(spec.task_id.hex(), None)
+            if deps:
+                # resolve arg dependencies before the send (reference
+                # actor_task_submitter dependency wait); event-driven via
+                # worker.wait, owner loss counts as resolved (the executor
+                # surfaces the error at arg fetch)
+                try:
+                    w.wait(deps, num_returns=len(deps), timeout_s=None)
+                except Exception:  # noqa: BLE001 — never wedge the sender
+                    logger.exception(
+                        "actor task %s dependency wait failed", spec.name
+                    )
             # A failed *send* (frame never accepted by the socket) is safe
             # to retry against the restarted actor; once the frame is out,
             # failures are classified by _actor_connection_lost instead.

@@ -13,6 +13,7 @@ runtime's when omitted.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.utils.rpc import ClientPool, RpcConnectionError, RpcError
@@ -86,6 +87,17 @@ def cluster_status(address: Optional[str] = None) -> Dict[str, Any]:
     nodes = list_nodes(address)
     agents = _agent_states(address)
     actors = list_actors(address)
+    infeasible = None
+    try:
+        raw = _control(address).call(
+            "kv_get", ns="autoscaler", key="infeasible", timeout_s=5.0
+        )
+        if raw:
+            rec = json.loads(bytes(raw).decode())
+            if time.time() - rec.get("ts", 0) < 60.0:  # recent only
+                infeasible = rec
+    except Exception:  # noqa: BLE001 — status must not fail on extras
+        pass
     total: Dict[str, float] = {}
     avail: Dict[str, float] = {}
     for st in agents:
@@ -106,6 +118,10 @@ def cluster_status(address: Optional[str] = None) -> Dict[str, Any]:
             ),
         },
         "workers": sum(len(st.get("workers", {})) for st in agents),
+        # demand no launchable node type can ever satisfy (autoscaler
+        # shape-aware scheduler; reference autoscaler/v2 reports the same
+        # through `ray status`'s "infeasible requests" section)
+        "infeasible_demand": infeasible,
         "object_store": {
             "used_bytes": sum(st["store_usage"][0] for st in agents),
             "capacity_bytes": sum(st["store_usage"][1] for st in agents),
@@ -197,9 +213,22 @@ def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:
                 elif m["kind"] == "gauge":
                     cur["series"][k] = v
                 else:  # histogram
+                    if tuple(m.get("boundaries", ())) != tuple(
+                        cur.get("boundaries", ())
+                    ):
+                        # divergent boundaries across workers: bucket-wise
+                        # merge would be meaningless and render a corrupt
+                        # Prometheus histogram (le="+Inf" < _count). Keep
+                        # count/sum, drop bucket detail for the metric.
+                        cur["boundaries"] = ()
+                        for st in cur["series"].values():
+                            st["buckets"] = []
                     prev = cur["series"].get(k)
                     if prev is None:
-                        cur["series"][k] = v
+                        cur["series"][k] = (
+                            v if cur.get("boundaries")
+                            else dict(v, buckets=[])
+                        )
                     else:
                         prev["sum"] += v["sum"]
                         prev["count"] += v["count"]

@@ -115,6 +115,9 @@ config.define("worker_idle_timeout_s", 600.0)
 config.define("scheduler_spread_threshold", 0.5)
 config.define("task_max_retries", 3)
 config.define("borrow_pin_ttl_s", 600.0)
+# Streaming generators: once the done-marker says item i exists, how long
+# to wait for its (in-flight) push before declaring the item lost.
+config.define("stream_item_grace_s", 30.0)
 # Owner-side lineage entries kept for object reconstruction (reference
 # bounds lineage by bytes; we bound by task count).
 config.define("lineage_max_entries", 10000)

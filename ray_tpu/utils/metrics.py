@@ -41,6 +41,12 @@ class _Metric:
                         f"metric {name!r} already registered as "
                         f"{existing.kind}"
                     )
+                if existing.tag_keys != self.tag_keys:
+                    raise ValueError(
+                        f"metric {name!r} already registered with tag_keys="
+                        f"{existing.tag_keys}"
+                    )
+                self._validate_rereg(existing)
                 # per-name singleton series: re-constructing a metric
                 # (e.g. inside a task that runs repeatedly on one worker)
                 # must accumulate into the SAME series, not reset it
@@ -48,6 +54,9 @@ class _Metric:
                 self._lock = existing._lock
             else:
                 _registry[name] = self
+
+    def _validate_rereg(self, existing: "_Metric") -> None:
+        """Kind-specific compatibility check on re-registration."""
 
     def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
         tags = tags or {}
@@ -101,8 +110,17 @@ class Histogram(_Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: Sequence[float] = _DEFAULT_BOUNDARIES,
                  tag_keys: Sequence[str] = ()):
-        super().__init__(name, description, tag_keys)
         self.boundaries = tuple(sorted(boundaries))
+        super().__init__(name, description, tag_keys)
+
+    def _validate_rereg(self, existing: "_Metric") -> None:
+        # a singleton's bucket arrays are sized for its boundaries —
+        # adopting them under different boundaries would misbin counts
+        if existing.boundaries != self.boundaries:
+            raise ValueError(
+                f"histogram {self.name!r} already registered with "
+                f"boundaries={existing.boundaries}"
+            )
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
@@ -146,7 +164,12 @@ def prometheus_text(snapshots: Dict[str, Dict]) -> str:
     lines: List[str] = []
     for name, snap in sorted(snapshots.items()):
         lines.append(f"# HELP {name} {snap.get('description', '')}")
-        kind = snap["kind"] if snap["kind"] != "histogram" else "histogram"
+        kind = snap["kind"]
+        if kind == "histogram" and not snap.get("boundaries"):
+            # bucket detail was dropped (divergent boundaries across
+            # workers, state.cluster_metrics): only count/sum remain,
+            # which is a summary, not a histogram
+            kind = "summary"
         lines.append(f"# TYPE {name} {kind}")
         for tagvals, value in snap["series"].items():
             labels = ",".join(
@@ -154,6 +177,13 @@ def prometheus_text(snapshots: Dict[str, Dict]) -> str:
             )
             label_s = "{" + labels + "}" if labels else ""
             if snap["kind"] == "histogram":
+                bounds = snap.get("boundaries", ())
+                cum = 0
+                for le, n in zip(list(bounds) + ["+Inf"], value["buckets"]):
+                    cum += n
+                    le_label = f'le="{le}"'
+                    all_labels = f"{labels},{le_label}" if labels else le_label
+                    lines.append(f"{name}_bucket{{{all_labels}}} {cum}")
                 lines.append(f"{name}_count{label_s} {value['count']}")
                 lines.append(f"{name}_sum{label_s} {value['sum']}")
             else:

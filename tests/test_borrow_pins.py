@@ -1,0 +1,164 @@
+"""Borrow-pin protection for pending-task args.
+
+Parity model: the reference keeps task-argument refs alive for the whole
+pendency of the task via borrow reports (reference_counter.h:44). Here the
+in-flight serialization pins carry a TTL — these tests pin the TTL very
+low and verify that args of a task stuck in a lease queue survive anyway
+(the round-3 verdict's correctness hole: a ref serialized into a task that
+waits longer than borrow_pin_ttl_s for a lease must NOT be freed).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.utils.config import config
+
+
+@pytest.fixture
+def rt_one_cpu():
+    old_ttl = config.borrow_pin_ttl_s
+    config.set("borrow_pin_ttl_s", 0.3)
+    ray_tpu.init(num_cpus=1)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    config.set("borrow_pin_ttl_s", old_ttl)
+
+
+def test_task_arg_ref_survives_lease_wait_longer_than_ttl(rt_one_cpu):
+    rt = rt_one_cpu
+
+    @rt.remote
+    def blocker(t):
+        time.sleep(t)
+        return "done"
+
+    @rt.remote
+    def consume(x):
+        return sum(x)
+
+    hold = blocker.remote(2.0)  # occupies the only CPU
+    time.sleep(0.2)  # ensure blocker holds the lease first
+
+    val = list(range(100))
+    ref = rt.put(val)
+    out = consume.remote(ref)  # queues behind blocker for ~2s >> TTL=0.3s
+    del ref  # only the in-flight arg pin keeps the object alive now
+
+    # Churn the tracker so TTL sweeps actually run during the wait
+    # (sweeps are opportunistic, rate-limited to TTL/4).
+    deadline = time.monotonic() + 1.5
+    while time.monotonic() < deadline:
+        tmp = rt.put(0)
+        del tmp
+        time.sleep(0.05)
+
+    assert rt.get(hold, timeout=30) == "done"
+    assert rt.get(out, timeout=30) == sum(val)
+
+
+def test_arg_ref_survives_retry_attempts(rt_one_cpu, tmp_path):
+    """The pendency borrow must outlive the FIRST execution attempt: a
+    retried task (retry_exceptions) deserializes its args again on each
+    attempt, after the previous executor already consumed the in-flight
+    pin and released its own borrow."""
+    rt = rt_one_cpu
+    marker = tmp_path / "attempts"
+
+    @rt.remote(retry_exceptions=True, max_retries=3)
+    def flaky(x):
+        import os
+
+        n = len(marker.read_text()) if marker.exists() else 0
+        marker.write_text("x" * (n + 1))
+        if n < 2:
+            time.sleep(0.5)  # let TTL elapse between attempts
+            raise RuntimeError(f"attempt {n} fails")
+        return sum(x)
+
+    val = list(range(64))
+    ref = rt.put(val)
+    out = flaky.remote(ref)
+    del ref  # only the pendency borrow keeps the object alive now
+
+    deadline = time.monotonic() + 1.2
+    while time.monotonic() < deadline:
+        tmp = rt.put(0)
+        del tmp
+        time.sleep(0.05)
+
+    assert rt.get(out, timeout=60) == sum(val)
+    assert len(marker.read_text()) == 3  # failed twice, succeeded third
+
+
+def test_restartable_actor_init_args_survive_restart(rt_one_cpu):
+    """A restartable actor re-deserializes its init args on restart: the
+    init-arg pendency borrows must NOT be released at first ALIVE."""
+    rt = rt_one_cpu
+
+    @rt.remote
+    class Holder:
+        def __init__(self, data):
+            self.data = data
+
+        def total(self):
+            return sum(self.data)
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+    ref = rt.put(list(range(32)))
+    h = Holder.options(max_restarts=1).remote(ref)
+    assert rt.get(h.total.remote(), timeout=30) == sum(range(32))
+    del ref  # init-arg borrow must keep the object for the restart
+
+    # let TTL sweeps run, then crash the actor
+    deadline = time.monotonic() + 0.8
+    while time.monotonic() < deadline:
+        tmp = rt.put(0)
+        del tmp
+        time.sleep(0.05)
+    try:
+        rt.get(h.crash.remote(), timeout=30)
+    except Exception:
+        pass
+    # restarted actor must have re-read the (still alive) init args
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert rt.get(h.total.remote(), timeout=10) == sum(range(32))
+            break
+        except AssertionError:
+            raise
+        except Exception:
+            time.sleep(0.2)
+    else:
+        raise AssertionError("actor did not restart with live init args")
+
+
+def test_unprotected_pin_still_swept(rt_one_cpu):
+    """The TTL sweep still collects pins that are NOT pending-task args
+    (serialized-but-never-deserialized refs must not leak forever)."""
+    rt = rt_one_cpu
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    tr = w.reference_tracker
+
+    ref = rt.put([1, 2, 3])
+    # Serialize outside any task-arg capture: an orphan in-flight pin.
+    import pickle
+
+    pickle.dumps(ref)
+    assert len(tr._escape_tokens) >= 1
+    del ref
+
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline and tr._escape_tokens:
+        tmp = rt.put(0)
+        del tmp
+        time.sleep(0.05)
+    assert not tr._escape_tokens, "orphan pin was never swept"

