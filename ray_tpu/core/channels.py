@@ -9,24 +9,48 @@ mmap once and then a memcpy + seqlock flip per message instead of
 object-store create/seal/get RPCs.
 
 Single-writer single-reader, same host. Layout:
-  [seq u64][len u64][payload ...]
+  [seq u64][ack u64][len u64][payload ...]
 The writer bumps seq AFTER the payload is fully written; the reader
-spins (with backoff) until seq advances past what it last consumed,
-then copies the payload out before validating seq is unchanged
-(torn-read guard).
+waits for seq to advance past what it last consumed, copies the payload
+out, then publishes ack=seq. The writer BLOCKS until ack catches up
+before overwriting — flow control, so a compiled DAG (ray_tpu/dag.py)
+can run producers ahead of consumers without losing messages (the
+reference's mutable objects block the writer on reader acquisition the
+same way).
+
+Waiting is hybrid: a short busy-spin on the shm header (single-digit µs
+wakeups when reader and writer run on different cores — the reference's
+compiled-graph regime), then a blocking poll on a FIFO doorbell so a
+core-starved box (or an idle DAG) parks in the kernel instead of
+burning the core the peer needs. The doorbell is only a hint; the shm
+header is the ground truth.
 """
 
 from __future__ import annotations
 
 import mmap
 import os
+import select
 import struct
 import time
 import uuid
 from typing import Optional
 
-_HDR = struct.Struct("<QQ")  # seq, payload_len
+_HDR = struct.Struct("<QQQ")  # seq, ack, payload_len
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+
+def _spin_window_s() -> float:
+    """How long to busy-poll the header before parking on the doorbell.
+    On a single-core box spinning only steals the cycles the peer needs
+    to produce the message — go straight to the kernel wait."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    return 0.0001 if cores > 1 else 0.0
+
+
+_SPIN_S = _spin_window_s()
 
 
 class ShmChannel:
@@ -43,8 +67,20 @@ class ShmChannel:
         finally:
             os.close(fd)
         if create:
-            self._mm[: _HDR.size] = _HDR.pack(0, 0)
-        self._last_read = int.from_bytes(self._mm[0:8], "little")
+            self._mm[: _HDR.size] = _HDR.pack(0, 0, 0)
+            for suffix in (".d", ".a"):
+                try:
+                    os.mkfifo(path + suffix, 0o600)
+                except FileExistsError:
+                    pass
+        # O_RDWR so neither side blocks in open() waiting for a peer
+        self._dbell = os.open(path + ".d", os.O_RDWR | os.O_NONBLOCK)
+        self._abell = os.open(path + ".a", os.O_RDWR | os.O_NONBLOCK)
+        # a reader resumes from what has been CONSUMED (ack), not from the
+        # latest seq — a message written before the reader attached (e.g.
+        # dag.execute racing the exec loop's channel attach) must still be
+        # delivered
+        self._last_read = int.from_bytes(self._mm[8:16], "little")
 
     @classmethod
     def create(cls, capacity: int = 4 * 1024 * 1024) -> "ShmChannel":
@@ -63,49 +99,90 @@ class ShmChannel:
     def from_handle(cls, handle) -> "ShmChannel":
         return cls.attach(handle["path"], handle["capacity"])
 
+    def _u64(self, off: int) -> int:
+        return int.from_bytes(self._mm[off: off + 8], "little")
+
+    @staticmethod
+    def _ring(fd: int) -> None:
+        try:
+            os.write(fd, b"\x01")
+        except BlockingIOError:
+            pass  # fifo full: peer has plenty of pending wakeups already
+
+    @staticmethod
+    def _drain(fd: int) -> None:
+        try:
+            os.read(fd, 64)
+        except BlockingIOError:
+            pass
+
+    def _await(self, ready, bell_fd: int,
+               deadline: Optional[float], what: str) -> None:
+        """Hybrid wait for ``ready()``: spin on the shm header, then park
+        on the doorbell fifo."""
+        spin_until = time.monotonic() + _SPIN_S if _SPIN_S else 0.0
+        while not ready():
+            if _SPIN_S and time.monotonic() < spin_until:
+                continue
+            remaining = 0.05
+            if deadline is not None:
+                remaining = min(remaining, deadline - time.monotonic())
+                if remaining <= 0:
+                    raise TimeoutError(f"channel {self.path}: {what}")
+            r, _, _ = select.select([bell_fd], [], [], max(remaining, 0.0))
+            if r:
+                self._drain(bell_fd)
+
     # -- writer --------------------------------------------------------
 
-    def write(self, payload: bytes) -> None:
+    def write(self, payload: bytes, timeout_s: Optional[float] = 60.0) -> None:
         if len(payload) > self.capacity:
             raise ValueError(
                 f"payload {len(payload)} > channel capacity {self.capacity}"
             )
-        seq = int.from_bytes(self._mm[0:8], "little")
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        seq = self._u64(0)
+        # flow control: previous message must have been consumed
+        self._await(
+            lambda: self._u64(8) >= seq, self._abell, deadline,
+            f"reader never consumed seq {seq}",
+        )
         self._mm[_HDR.size: _HDR.size + len(payload)] = payload
-        self._mm[8:16] = len(payload).to_bytes(8, "little")
+        self._mm[16:24] = len(payload).to_bytes(8, "little")
         # publish: bump seq last (release on x86/ARM via GIL + mmap)
         self._mm[0:8] = (seq + 1).to_bytes(8, "little")
+        self._ring(self._dbell)
 
     # -- reader --------------------------------------------------------
 
     def read(self, timeout_s: Optional[float] = 30.0) -> bytes:
         """Block until a message newer than the last one read arrives."""
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
-        spins = 0
-        while True:
-            seq = int.from_bytes(self._mm[0:8], "little")
-            if seq > self._last_read:
-                length = int.from_bytes(self._mm[8:16], "little")
-                data = bytes(self._mm[_HDR.size: _HDR.size + length])
-                seq2 = int.from_bytes(self._mm[0:8], "little")
-                if seq2 == seq:
-                    self._last_read = seq
-                    return data
-                # torn read (writer overwrote mid-copy): retry
-                continue
-            spins += 1
-            if spins > 1000:
-                time.sleep(0.0005)
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"channel {self.path}: no message")
+        self._await(
+            lambda: self._u64(0) > self._last_read, self._dbell, deadline,
+            "no message",
+        )
+        seq = self._u64(0)
+        length = self._u64(16)
+        data = bytes(self._mm[_HDR.size: _HDR.size + length])
+        self._last_read = seq
+        self._mm[8:16] = seq.to_bytes(8, "little")  # ack
+        self._ring(self._abell)
+        return data
 
     def close(self, unlink: bool = False) -> None:
         try:
             self._mm.close()
         except (BufferError, ValueError):
             pass
-        if unlink:
+        for fd in (self._dbell, self._abell):
             try:
-                os.unlink(self.path)
+                os.close(fd)
             except OSError:
                 pass
+        if unlink:
+            for p in (self.path, self.path + ".d", self.path + ".a"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
