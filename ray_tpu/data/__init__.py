@@ -6,7 +6,9 @@ streaming executor over the object plane, per-Train-worker iterators).
 
 from ray_tpu.data.block import Block, BlockAccessor, BlockMeta
 from ray_tpu.data.dataset import (
+    AggregateFn,
     Dataset,
+    GroupedData,
     from_items,
     from_numpy,
     range,  # noqa: A004
@@ -19,11 +21,13 @@ from ray_tpu.data.dataset import (
 from ray_tpu.data.iterator import DataIterator
 
 __all__ = [
+    "AggregateFn",
     "Block",
     "BlockAccessor",
     "BlockMeta",
     "DataIterator",
     "Dataset",
+    "GroupedData",
     "from_items",
     "from_numpy",
     "range",

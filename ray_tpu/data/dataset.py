@@ -75,6 +75,20 @@ class Dataset:
     def union(self, *others: "Dataset") -> "Dataset":
         return self._with(logical.Union([o._plan for o in others]))
 
+    def sort(self, key: Any = None, *, descending: bool = False) -> "Dataset":
+        """Global sort via sample → range-partition → per-partition sort
+        (a true all-to-all; parity: reference Dataset.sort)."""
+        return self._with(logical.Sort(key, descending))
+
+    def groupby(self, key: Any) -> "GroupedData":
+        """Hash-partitioned grouping (parity: reference Dataset.groupby)."""
+        return GroupedData(self, key)
+
+    def join(self, other: "Dataset", on: Any, how: str = "inner") -> "Dataset":
+        """Distributed hash join (parity: reference joins,
+        python/ray/data/_internal/logical/operations/join.py)."""
+        return self._with(logical.Join(other._plan, on, how))
+
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Deterministic 1/num_shards of the block stream (round-robin by
         block position) — the per-Train-worker split."""
@@ -242,3 +256,81 @@ def read_parquet(paths, *, columns=None, parallelism: int = 4) -> Dataset:
         ),
         parallelism,
     )
+
+
+class AggregateFn:
+    """One aggregation over a group's rows (parity: reference
+    ray.data.aggregate.AggregateFn)."""
+
+    def __init__(self, name: str, compute: Callable[[List[Any]], Any]):
+        self.name = name
+        self.compute = compute
+
+    @staticmethod
+    def count(name: str = "count") -> "AggregateFn":
+        return AggregateFn(name, lambda rows: len(rows))
+
+    @staticmethod
+    def of_column(kind: str, col: Any, name: Optional[str] = None) -> "AggregateFn":
+        get = col if callable(col) else (lambda r, c=col: r[c])
+        reducers = {
+            "sum": lambda vals: sum(vals),
+            "min": lambda vals: min(vals),
+            "max": lambda vals: max(vals),
+            "mean": lambda vals: sum(vals) / len(vals),
+        }
+        red = reducers[kind]
+        label = name or (f"{kind}({col})" if isinstance(col, str) else kind)
+        return AggregateFn(label, lambda rows: red([get(r) for r in rows]))
+
+
+class GroupedData:
+    """`ds.groupby(key)` result: aggregations run as a distributed hash
+    shuffle (map: hash-partition by key; reduce: per-partition grouped
+    aggregation). Parity: reference GroupedData
+    (python/ray/data/grouped_data.py over hash_shuffle.py)."""
+
+    def __init__(self, ds: Dataset, key: Any):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs) -> Dataset:
+        """Accepts AggregateFn objects or ``(column, kind)`` tuple
+        shorthand (kind ∈ sum/min/max/mean, named ``{column}_{kind}``)."""
+        normalized: List[AggregateFn] = []
+        for a in aggs:
+            if isinstance(a, AggregateFn):
+                normalized.append(a)
+            elif (
+                isinstance(a, tuple) and len(a) == 2
+                and a[1] in ("sum", "min", "max", "mean")
+            ):
+                normalized.append(
+                    AggregateFn.of_column(a[1], a[0], name=f"{a[0]}_{a[1]}")
+                )
+            else:
+                raise TypeError(
+                    f"aggregate spec {a!r} is not an AggregateFn or a "
+                    "(column, 'sum'|'min'|'max'|'mean') tuple"
+                )
+        return self._ds._with(
+            logical.GroupByAggregate(self._key, normalized)
+        )
+
+    def count(self) -> Dataset:
+        return self.aggregate(AggregateFn.count())
+
+    def sum(self, col: Any) -> Dataset:
+        return self.aggregate(AggregateFn.of_column("sum", col))
+
+    def min(self, col: Any) -> Dataset:
+        return self.aggregate(AggregateFn.of_column("min", col))
+
+    def max(self, col: Any) -> Dataset:
+        return self.aggregate(AggregateFn.of_column("max", col))
+
+    def mean(self, col: Any) -> Dataset:
+        return self.aggregate(AggregateFn.of_column("mean", col))
+
+    def map_groups(self, fn: Callable[[List[Any]], Any]) -> Dataset:
+        return self._ds._with(logical.MapGroups(self._key, fn))

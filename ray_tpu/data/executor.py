@@ -30,6 +30,7 @@ from ray_tpu.core.api import get, put, remote, wait
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.data import logical
 from ray_tpu.data.block import Block, BlockAccessor, BlockMeta, normalize_batch_output
+from ray_tpu.utils import serialization
 
 logger = logging.getLogger(__name__)
 
@@ -490,6 +491,15 @@ def _apply_boundary(
         return _repartition(bundles, op.num_blocks)
     if isinstance(op, logical.RandomShuffle):
         return _random_shuffle(bundles, op.seed)
+    if isinstance(op, logical.Sort):
+        return _sort_boundary(bundles, op.key, op.descending)
+    if isinstance(op, logical.GroupByAggregate):
+        return _groupby_boundary(bundles, op.key, op.aggs)
+    if isinstance(op, logical.MapGroups):
+        return _map_groups_boundary(bundles, op.key, op.fn)
+    if isinstance(op, logical.Join):
+        right = execute_plan_materialized(op.other)
+        return _join_boundary(bundles, right, op.on, op.how)
     if isinstance(op, logical.Union):
         out = list(bundles)
         for other in op.others:
@@ -529,22 +539,280 @@ def _repartition(bundles: List[RefBundle], n: int) -> List[RefBundle]:
 def _random_shuffle(
     bundles: List[RefBundle], seed: Optional[int]
 ) -> List[RefBundle]:
-    """Block-order permutation + per-block row shuffle (the reference's
-    randomize_block_order + local shuffle approximation of a full
-    shuffle; exact all-to-all shuffle costs a materialized transpose)."""
-    rng = np.random.default_rng(seed)
-    order = rng.permutation(len(bundles))
-    out: List[RefBundle] = []
-    pending: List[Tuple[ObjectRef, ObjectRef]] = []
-    for pos in order:
-        ref, _ = bundles[pos]
-        block_ref, meta_ref = _shuffle_rows.options(num_returns=2).remote(
-            ref, int(rng.integers(0, 2**31))
+    """EXACT distributed shuffle: every row is hash-assigned a random
+    output partition (map tasks), each partition concatenates its pieces
+    from every input block and permutes locally (reduce tasks) — a true
+    all-to-all through the object store (parity: reference
+    hash_shuffle.py), replacing round 3's block-order permutation."""
+    if not bundles:
+        return []
+    P = max(1, len(bundles))
+    base = seed if seed is not None else 0
+    map_blob = serialization.dumps_function(
+        lambda rows, shard_seed: np.random.default_rng(
+            (base, shard_seed)
+        ).integers(0, P, size=len(rows))
+    )
+    reduce_blob = serialization.dumps_function(
+        lambda rows, p: [
+            rows[i]
+            for i in np.random.default_rng((base, 1 << 20, p)).permutation(
+                len(rows)
+            )
+        ]
+    )
+    return _all_to_all(bundles, P, map_blob, reduce_blob)
+
+
+@remote
+def _partition_block(map_blob, P: int, shard_id: int, block):
+    """Map side of the all-to-all: rows → P partition piece-blocks plus a
+    trailing None filler so num_returns is static (P + 1)."""
+    fn = serialization.loads(map_blob)
+    rows = list(BlockAccessor.for_block(block).iter_rows())
+    assign = fn(rows, shard_id)
+    pieces: List[List[Any]] = [[] for _ in range(P)]
+    for row, p in zip(rows, assign):
+        pieces[int(p)].append(row)
+    return (*pieces, None)
+
+
+@remote
+def _reduce_partition(reduce_blob, p: int, *pieces):
+    """Reduce side: concatenate this partition's pieces from every map
+    task and apply the reduce fn."""
+    fn = serialization.loads(reduce_blob)
+    rows: List[Any] = []
+    for piece in pieces:
+        if piece:
+            rows.extend(piece)
+    out = fn(rows, p)
+    return out, BlockMeta.of(out)
+
+
+def _all_to_all(
+    bundles: List[RefBundle], P: int, map_blob: bytes, reduce_blob: bytes
+) -> List[RefBundle]:
+    """Generic hash/range shuffle: map each block into P pieces, reduce
+    each partition over all blocks' pieces. Pieces travel as ObjectRefs
+    through the store — the transpose never lands on the driver."""
+    piece_refs: List[List[ObjectRef]] = []
+    for shard_id, (ref, _) in enumerate(bundles):
+        refs = _partition_block.options(num_returns=P + 1).remote(
+            map_blob, P, shard_id, ref
         )
-        pending.append((block_ref, meta_ref))
-    for block_ref, meta_ref in pending:
-        out.append((block_ref, get(meta_ref)))
-    return out
+        piece_refs.append(refs[:P])
+    pending = [
+        _reduce_partition.options(num_returns=2).remote(
+            reduce_blob, p, *[piece_refs[i][p] for i in range(len(bundles))]
+        )
+        for p in range(P)
+    ]
+    return [(ref, get(meta_ref)) for ref, meta_ref in pending]
+
+
+def _key_fn_blob(key) -> bytes:
+    if callable(key):
+        return serialization.dumps_function(key)
+    if key is None:
+        return serialization.dumps_function(lambda row: row)
+    return serialization.dumps_function(lambda row, k=key: row[k])
+
+
+def _sort_boundary(
+    bundles: List[RefBundle], key, descending: bool
+) -> List[RefBundle]:
+    """Sample → range partition → per-partition sort; partition order =
+    global order."""
+    if not bundles:
+        return []
+    P = max(1, len(bundles))
+    key_blob = _key_fn_blob(key)
+    sample_refs = [
+        _sample_keys.remote(key_blob, ref, 64) for ref, _ in bundles
+    ]
+    samples = sorted(x for part in get(sample_refs) for x in part)
+    if not samples:
+        return bundles
+    # P-1 quantile boundaries over the sampled keys
+    bounds = [
+        samples[(j * len(samples)) // P] for j in range(1, P)
+    ]
+
+    def map_fn(rows, shard_id, key_blob=key_blob, bounds=bounds,
+               descending=descending):
+        import bisect
+
+        kf = serialization.loads(key_blob)
+        out = []
+        for row in rows:
+            p = bisect.bisect_right(bounds, kf(row))
+            if descending:
+                p = len(bounds) - p
+            out.append(p)
+        return out
+
+    def reduce_fn(rows, p, key_blob=key_blob, descending=descending):
+        kf = serialization.loads(key_blob)
+        return sorted(rows, key=kf, reverse=descending)
+
+    return _all_to_all(
+        bundles, P,
+        serialization.dumps_function(map_fn),
+        serialization.dumps_function(reduce_fn),
+    )
+
+
+@remote
+def _sample_keys(key_blob, block, k: int):
+    kf = serialization.loads(key_blob)
+    rows = list(BlockAccessor.for_block(block).iter_rows())
+    if not rows:
+        return []
+    idx = np.random.default_rng(0).choice(
+        len(rows), size=min(k, len(rows)), replace=False
+    )
+    return [kf(rows[i]) for i in idx]
+
+
+def _hash_partition_map_blob(key_blob: bytes, P: int) -> bytes:
+    def map_fn(rows, shard_id, key_blob=key_blob, P=P):
+        kf = serialization.loads(key_blob)
+        # stable across processes (python hash() is salted): md5 the repr
+        import hashlib
+
+        out = []
+        for row in rows:
+            h = hashlib.md5(repr(kf(row)).encode()).digest()
+            out.append(int.from_bytes(h[:4], "little") % P)
+        return out
+
+    return serialization.dumps_function(map_fn)
+
+
+def _groupby_boundary(
+    bundles: List[RefBundle], key, aggs: List[Any]
+) -> List[RefBundle]:
+    if not bundles:
+        return []
+    P = max(1, len(bundles))
+    key_blob = _key_fn_blob(key)
+    aggs_blob = serialization.dumps_function(lambda: aggs)
+
+    def reduce_fn(rows, p, key_blob=key_blob, aggs_blob=aggs_blob, key=key):
+        kf = serialization.loads(key_blob)
+        agg_list = serialization.loads(aggs_blob)()
+        groups: Dict[Any, List[Any]] = {}
+        for row in rows:
+            groups.setdefault(kf(row), []).append(row)
+        out = []
+        key_col = key if isinstance(key, str) else "key"
+        for gkey in sorted(groups, key=repr):
+            grows = groups[gkey]
+            rec = {key_col: gkey}
+            for agg in agg_list:
+                rec[agg.name] = agg.compute(grows)
+            out.append(rec)
+        return out
+
+    return _all_to_all(
+        bundles, P, _hash_partition_map_blob(key_blob, P),
+        serialization.dumps_function(reduce_fn),
+    )
+
+
+def _map_groups_boundary(
+    bundles: List[RefBundle], key, fn
+) -> List[RefBundle]:
+    if not bundles:
+        return []
+    P = max(1, len(bundles))
+    key_blob = _key_fn_blob(key)
+    fn_blob = serialization.dumps_function(fn)
+
+    def reduce_fn(rows, p, key_blob=key_blob, fn_blob=fn_blob):
+        kf = serialization.loads(key_blob)
+        gfn = serialization.loads(fn_blob)
+        groups: Dict[Any, List[Any]] = {}
+        for row in rows:
+            groups.setdefault(kf(row), []).append(row)
+        out: List[Any] = []
+        for gkey in sorted(groups, key=repr):
+            res = gfn(groups[gkey])
+            out.extend(res if isinstance(res, list) else [res])
+        return out
+
+    return _all_to_all(
+        bundles, P, _hash_partition_map_blob(key_blob, P),
+        serialization.dumps_function(reduce_fn),
+    )
+
+
+@remote
+def _join_partition(on_blob, how: str, n_left: int, *pieces):
+    """Hash-join one partition: pieces[:n_left] are left pieces,
+    the rest right pieces."""
+    kf = serialization.loads(on_blob)
+    left_rows: List[Any] = []
+    right_rows: List[Any] = []
+    for piece in pieces[:n_left]:
+        if piece:
+            left_rows.extend(piece)
+    for piece in pieces[n_left:]:
+        if piece:
+            right_rows.extend(piece)
+    right_by_key: Dict[Any, List[Any]] = {}
+    for row in right_rows:
+        right_by_key.setdefault(kf(row), []).append(row)
+    out = []
+    matched_right: set = set()
+    for lrow in left_rows:
+        k = kf(lrow)
+        matches = right_by_key.get(k)
+        if matches:
+            matched_right.add(repr(k))
+            for rrow in matches:
+                merged = dict(lrow)
+                merged.update(rrow)
+                out.append(merged)
+        elif how in ("left", "outer"):
+            out.append(dict(lrow))
+    if how in ("right", "outer"):
+        for k, rows in right_by_key.items():
+            if repr(k) not in matched_right:
+                out.extend(dict(r) for r in rows)
+    return out, BlockMeta.of(out)
+
+
+def _join_boundary(
+    left: List[RefBundle], right: List[RefBundle], on, how: str
+) -> List[RefBundle]:
+    if not left and not right:
+        return []
+    P = max(1, max(len(left), len(right)))
+    on_blob = _key_fn_blob(on)
+    map_blob = _hash_partition_map_blob(on_blob, P)
+    left_pieces: List[List[ObjectRef]] = []
+    right_pieces: List[List[ObjectRef]] = []
+    for shard_id, (ref, _) in enumerate(left):
+        refs = _partition_block.options(num_returns=P + 1).remote(
+            map_blob, P, shard_id, ref
+        )
+        left_pieces.append(refs[:P])
+    for shard_id, (ref, _) in enumerate(right):
+        refs = _partition_block.options(num_returns=P + 1).remote(
+            map_blob, P, shard_id, ref
+        )
+        right_pieces.append(refs[:P])
+    pending = [
+        _join_partition.options(num_returns=2).remote(
+            on_blob, how, len(left),
+            *[left_pieces[i][p] for i in range(len(left))],
+            *[right_pieces[i][p] for i in range(len(right))],
+        )
+        for p in range(P)
+    ]
+    return [(ref, get(meta_ref)) for ref, meta_ref in pending]
 
 
 def execute_plan_streaming(

@@ -194,6 +194,57 @@ class Union(LogicalOp):
         self.others = others
 
 
+class Sort(LogicalOp):
+    """Global sort: sample → range-partition → per-partition sort
+    (parity: reference sort via all-to-all operator,
+    python/ray/data/_internal/logical/operations/all_to_all_operator.py)."""
+
+    name = "Sort"
+    one_to_one = False
+
+    def __init__(self, key: Any, descending: bool = False):
+        self.key = key
+        self.descending = descending
+
+
+class GroupByAggregate(LogicalOp):
+    """Hash-partition by key → per-partition grouped aggregation
+    (parity: reference hash_shuffle.py groupby/aggregate)."""
+
+    name = "GroupByAggregate"
+    one_to_one = False
+
+    def __init__(self, key: Any, aggs: List[Any]):
+        self.key = key
+        self.aggs = aggs
+
+
+class MapGroups(LogicalOp):
+    """Hash-partition by key → per-partition apply fn(group_rows)."""
+
+    name = "MapGroups"
+    one_to_one = False
+
+    def __init__(self, key: Any, fn: Any):
+        self.key = key
+        self.fn = fn
+
+
+class Join(LogicalOp):
+    """Hash join with another plan (parity: reference
+    python/ray/data/_internal/logical/operations/join.py)."""
+
+    name = "Join"
+    one_to_one = False
+
+    def __init__(self, other: "LogicalPlan", on: Any, how: str = "inner"):
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join how={how!r}")
+        self.other = other
+        self.on = on
+        self.how = how
+
+
 class LogicalPlan:
     """Immutable op chain; `with_op` returns an extended copy."""
 

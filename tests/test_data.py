@@ -257,3 +257,104 @@ def test_train_dataset_shards_reexecute(rt, tmp_path):
     ids1 = json.load(open(f"{out_dir}/ids_1.json"))
     assert not (set(ids0) & set(ids1)), "shards overlap"
     assert sorted(ids0 + ids1) == list(range(64))
+
+
+# ---------------------------------------------------------------------------
+# all-to-all tier: sort / groupby / join / exact shuffle
+# (parity model: python/ray/data/tests/test_sort.py, test_groupby.py)
+# ---------------------------------------------------------------------------
+
+
+def test_sort_global_order(rt):
+    rng = np.random.RandomState(3)
+    vals = rng.randint(0, 10_000, size=500).tolist()
+    ds = rtd.from_items(
+        [{"v": v} for v in vals], parallelism=8
+    ).sort(key="v")
+    out = [r["v"] for r in ds.take_all()]
+    assert out == sorted(vals)
+
+
+def test_sort_descending_callable_key(rt):
+    vals = [5, 3, 9, 1, 7, 2, 8]
+    ds = rtd.from_items(vals, parallelism=3).sort(
+        key=lambda x: x, descending=True
+    )
+    assert ds.take_all() == sorted(vals, reverse=True)
+
+
+def test_groupby_aggregate_matches_pandas(rt):
+    """>16 blocks; compare against a pandas groupby oracle (VERDICT
+    round-3 item 6)."""
+    import pandas as pd
+
+    rng = np.random.RandomState(7)
+    rows = [
+        {"k": int(k), "v": float(v)}
+        for k, v in zip(
+            rng.randint(0, 23, size=800), rng.randn(800) * 10
+        )
+    ]
+    ds = rtd.from_items(rows, parallelism=20)
+    out = (
+        ds.groupby("k")
+        .aggregate(
+            rtd.AggregateFn.count("n"),
+            rtd.AggregateFn.of_column("sum", "v", "v_sum"),
+            rtd.AggregateFn.of_column("mean", "v", "v_mean"),
+            rtd.AggregateFn.of_column("max", "v", "v_max"),
+        )
+        .take_all()
+    )
+    got = {r["k"]: r for r in out}
+    pdf = pd.DataFrame(rows).groupby("k")["v"].agg(["count", "sum", "mean", "max"])
+    assert set(got) == set(pdf.index)
+    for k, row in pdf.iterrows():
+        assert got[k]["n"] == row["count"]
+        np.testing.assert_allclose(got[k]["v_sum"], row["sum"], rtol=1e-9)
+        np.testing.assert_allclose(got[k]["v_mean"], row["mean"], rtol=1e-9)
+        np.testing.assert_allclose(got[k]["v_max"], row["max"], rtol=1e-9)
+
+
+def test_groupby_map_groups(rt):
+    rows = [{"k": i % 3, "v": i} for i in range(30)]
+    out = (
+        rtd.from_items(rows, parallelism=5)
+        .groupby("k")
+        .map_groups(lambda grp: {"k": grp[0]["k"], "total": sum(r["v"] for r in grp)})
+        .take_all()
+    )
+    got = {r["k"]: r["total"] for r in out}
+    assert got == {
+        0: sum(i for i in range(30) if i % 3 == 0),
+        1: sum(i for i in range(30) if i % 3 == 1),
+        2: sum(i for i in range(30) if i % 3 == 2),
+    }
+
+
+def test_join_inner_and_left(rt):
+    left = rtd.from_items(
+        [{"id": i, "a": i * 10} for i in range(8)], parallelism=3
+    )
+    right = rtd.from_items(
+        [{"id": i, "b": i * 100} for i in range(4, 12)], parallelism=3
+    )
+    inner = left.join(right, on="id").take_all()
+    assert sorted(r["id"] for r in inner) == [4, 5, 6, 7]
+    for r in inner:
+        assert r["a"] == r["id"] * 10 and r["b"] == r["id"] * 100
+    lf = left.join(right, on="id", how="left").take_all()
+    assert sorted(r["id"] for r in lf) == list(range(8))
+    assert sum(1 for r in lf if "b" not in r) == 4
+
+
+def test_random_shuffle_is_exact_permutation(rt):
+    n = 400
+    ds = rtd.range(n, parallelism=8).random_shuffle(seed=11)
+    out = [r["id"] for r in ds.take_all()]
+    assert sorted(out) == list(range(n))
+    assert out != list(range(n))  # actually shuffled
+    # deterministic under the same seed
+    out2 = [r["id"] for r in rtd.range(n, parallelism=8)
+            .random_shuffle(seed=11).take_all()]
+    assert out == out2
