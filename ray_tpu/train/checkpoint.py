@@ -75,7 +75,9 @@ class CheckpointManager:
                 if step in known:
                     continue
                 path = os.path.join(self.run_dir, name)
-                if expected_ranks is not None:
+                if os.path.exists(os.path.join(path, "_complete.json")):
+                    pass  # all ranks landed (post-barrier marker)
+                elif expected_ranks is not None:
                     ranks = [
                         d for d in os.listdir(path) if d.startswith("rank_")
                     ]

@@ -21,6 +21,26 @@ class ScalingConfig:
     tpu_chips_per_worker: int = 0
     topology: Optional[str] = None  # e.g. "v5e-16" → slice-aware placement
     placement_strategy: str = "PACK"
+    # Elastic bounds (parity: reference ElasticScalingPolicy,
+    # train/v2/_internal/execution/scaling_policy/elastic.py:29): when
+    # min_workers is set, the controller restarts the group at the
+    # largest FEASIBLE world size in [min_workers, max_workers] after a
+    # failure, and resizes back up (from the latest checkpoint) when
+    # capacity returns. max_workers defaults to num_workers.
+    min_workers: Optional[int] = None
+    max_workers: Optional[int] = None
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
+
+    def elastic_bounds(self) -> "tuple[int, int]":
+        lo = self.min_workers if self.min_workers is not None else self.num_workers
+        hi = self.max_workers if self.max_workers is not None else self.num_workers
+        return lo, hi
+
+    def resized(self, n: int) -> "ScalingConfig":
+        return dataclasses.replace(self, num_workers=n)
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

@@ -34,7 +34,17 @@ class TrainContext:
         self.collective_group = collective_group
         self.dataset_shards = dataset_shards or {}
         self.reports: List[Dict[str, Any]] = []
+        # resume the step counter from the restored checkpoint so a
+        # restarted (or elastically resized) run never overwrites earlier
+        # steps' checkpoint dirs
         self.report_step = 0
+        if restore_checkpoint is not None:
+            base = os.path.basename(restore_checkpoint.path.rstrip("/"))
+            if base.startswith("checkpoint_"):
+                try:
+                    self.report_step = int(base.split("_")[1])
+                except (IndexError, ValueError):
+                    pass
 
     # -- API parity --
 
@@ -112,3 +122,12 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> 
         from ray_tpu import collective
 
         collective.barrier(ctx.collective_group)
+    if checkpoint is not None and ctx.run_dir is not None and ctx.world_rank == 0:
+        # past the barrier every rank's shard landed: mark the step
+        # COMPLETE with the world size that wrote it (an elastic restart
+        # at a different size must not mistake a partial write for done)
+        import json
+
+        step_dir = os.path.join(ctx.run_dir, f"checkpoint_{step:06d}")
+        with open(os.path.join(step_dir, "_complete.json"), "w") as f:
+            json.dump({"world_size": ctx.world_size, "step": step}, f)

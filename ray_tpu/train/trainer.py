@@ -74,9 +74,25 @@ class DataParallelTrainer:
         return [serialization.dumps_function(d) for d in per_rank]
 
     def fit(self) -> Result:
+        if self.scaling_config.elastic and self.datasets:
+            raise ValueError(
+                "elastic scaling with datasets= is not supported yet: "
+                "dataset shards are split at the initial world size"
+            )
         run_dir = self._run_dir()
         cc = self.run_config.checkpoint_config
-        controller = TrainController.options(num_cpus=0).remote(
+        # Pin the controller to the driver's node (reference v2 runs the
+        # controller IN the driver process): it must not die with an
+        # arbitrary worker node — its job is to outlive worker failures.
+        from ray_tpu.core.api import NodeAffinitySchedulingStrategy
+
+        controller = TrainController.options(
+            num_cpus=0,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=ray_tpu.get_runtime_context().get_node_id(),
+                soft=True,
+            ),
+        ).remote(
             self.scaling_config,
             run_dir,
             self.run_config.failure_config.max_failures,

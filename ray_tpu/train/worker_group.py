@@ -105,6 +105,14 @@ class TrainWorker:
         return ctx.reports
 
 
+class PlacementTimeoutError(RuntimeError):
+    """The group's placement group did not become ready in time. In
+    elastic mode this is a RESIZE signal, not a failure: the desired
+    world size was computed from a cluster view that may not have
+    registered node deaths yet (health_check_timeout_s lag), so the
+    controller recomputes feasibility and retries smaller."""
+
+
 class WorkerGroup:
     def __init__(self, scaling: ScalingConfig, run_dir: Optional[str]):
         self.scaling = scaling
@@ -112,16 +120,17 @@ class WorkerGroup:
         self.pg = None
         self.workers: List[Any] = []
 
-    def start(self) -> None:
+    def start(self, ready_timeout_s: float = 120.0) -> None:
         n = self.scaling.num_workers
         res = self.scaling.worker_resources()
         self.pg = ray_tpu.placement_group(
             [dict(res) for _ in range(n)],
             strategy=self.scaling.placement_strategy,
         )
-        if not self.pg.wait(timeout_seconds=120):
-            raise RuntimeError(
-                f"placement group for {n} x {res} not schedulable"
+        if not self.pg.wait(timeout_seconds=ready_timeout_s):
+            raise PlacementTimeoutError(
+                f"placement group for {n} x {res} not ready in "
+                f"{ready_timeout_s}s"
             )
         self.workers = [
             TrainWorker.options(

@@ -1,0 +1,127 @@
+"""Elastic Train (VERDICT round-3 item 5; parity: reference
+ElasticScalingPolicy, train/v2/_internal/execution/scaling_policy/
+elastic.py:29,191): a 4-worker group loses nodes, resumes at 2 from the
+latest checkpoint, and upscales back to 4 when capacity returns — with a
+continuous step sequence."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.train import FailureConfig, JaxTrainer, RunConfig, ScalingConfig
+
+
+def _elastic_train_fn(config):
+    import os
+    import pickle
+    import tempfile
+
+    import ray_tpu.train as train
+    from ray_tpu.core import worker as wm
+
+    ctx = train.get_context()
+    start_step = 0
+    weight = 0.0
+    restore = ctx.get_checkpoint()
+    if restore is not None:
+        # an upscaled rank may have no shard of its own (the checkpoint
+        # was written by a smaller world): data-parallel state is
+        # replicated, so fall back to rank 0's shard
+        rank_dir = restore.rank_dir(ctx.get_world_rank())
+        if not os.path.isdir(rank_dir):
+            rank_dir = restore.rank_dir(0)
+        with open(os.path.join(rank_dir, "state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        weight, start_step = state["weight"], state["step"]
+
+    for step in range(start_step, config["steps"]):
+        time.sleep(config.get("step_s", 0.3))
+        weight += 1.0  # "training": weight == completed steps
+        if ctx.get_world_rank() == 0:
+            wm.global_worker().control.call(
+                "kv_put", ns="test",
+                key=f"ws_at_step_{step:03d}",
+                value=str(ctx.get_world_size()).encode(),
+            )
+        with tempfile.TemporaryDirectory() as tmp:
+            with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+                pickle.dump({"weight": weight, "step": step + 1}, f)
+            train.report(
+                {"step": step, "weight": weight,
+                 "world": ctx.get_world_size()},
+                checkpoint=train.Checkpoint.from_directory(tmp),
+            )
+
+
+def test_elastic_downscale_then_upscale(tmp_path):
+    c = Cluster()
+    try:
+        c.add_node(num_cpus=1)  # head: hosts the controller actor
+        worker_nodes = [c.add_node(num_cpus=1) for _ in range(4)]
+        ray_tpu.init(address=c.address)
+
+        steps = 40
+        trainer = JaxTrainer(
+            _elastic_train_fn,
+            train_loop_config={"steps": steps, "step_s": 0.5},
+            scaling_config=ScalingConfig(
+                num_workers=4, min_workers=2, max_workers=4,
+            ),
+            run_config=RunConfig(
+                name="elastic", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=3),
+            ),
+        )
+
+        import threading
+
+        result_box = {}
+
+        def fit():
+            result_box["result"] = trainer.fit()
+
+        t = threading.Thread(target=fit, daemon=True)
+        t.start()
+
+        # let the 4-worker group make progress, then kill two nodes
+        time.sleep(6.0)
+        c.kill_node(worker_nodes[2])
+        c.kill_node(worker_nodes[3])
+        # after the group resumes at 2, give capacity back
+        time.sleep(12.0)
+        c.add_node(num_cpus=1)
+        c.add_node(num_cpus=1)
+
+        t.join(timeout=240)
+        assert not t.is_alive(), "elastic train run never finished"
+        result = result_box["result"]
+        assert result.error is None, result.error
+        assert result.metrics["step"] == steps - 1  # ran to completion
+        # weight counts every completed step exactly once (continuity:
+        # restarts resumed from checkpoints, never from scratch)
+        assert result.metrics["weight"] == float(steps)
+
+        from ray_tpu.core import worker as wm
+
+        ws = {}
+        for s in range(steps):
+            raw = wm.global_worker().control.call(
+                "kv_get", ns="test", key=f"ws_at_step_{s:03d}"
+            )
+            if raw:
+                ws[s] = int(raw.decode())
+        sizes = [ws[s] for s in sorted(ws)]
+        assert 4 in sizes, f"never ran at 4 workers: {sizes}"
+        assert 2 in sizes or 3 in sizes, (
+            f"never ran downsized after node loss: {sizes}"
+        )
+        # upscaled back: the LAST steps ran at 4 again
+        assert sizes[-1] == 4, f"never upscaled back to 4: {sizes}"
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            c.shutdown()
