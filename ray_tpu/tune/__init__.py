@@ -5,7 +5,12 @@ controller over actors, search spaces, ASHA early stopping, per-trial
 checkpoints).
 """
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.trainable import Trainable, with_resources
 from ray_tpu.tune.search import (
     choice,
     grid_search,
@@ -13,11 +18,20 @@ from ray_tpu.tune.search import (
     randint,
     uniform,
 )
-from ray_tpu.tune.session import get_trial_dir, load_checkpoint, report
+from ray_tpu.tune.session import (
+    get_checkpoint,
+    get_trial_dir,
+    load_checkpoint,
+    report,
+)
 from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler",
+    "PopulationBasedTraining",
+    "Trainable",
+    "get_checkpoint",
+    "with_resources",
     "FIFOScheduler",
     "ResultGrid",
     "TrialResult",

@@ -73,3 +73,92 @@ class ASHAScheduler:
             good = value >= cutoff if self.mode == "max" else value <= cutoff
             return CONTINUE if good else STOP
         return CONTINUE
+
+
+class PopulationBasedTraining:
+    """PBT (parity: reference python/ray/tune/schedulers/pbt.py):
+    at each perturbation interval a bottom-quantile trial EXPLOITS a
+    top-quantile trial — it restarts from the winner's latest checkpoint
+    with EXPLORED (mutated) hyperparameters. Decisions are returned to
+    the controller as ("EXPLOIT", source_trial_id, mutated_config); the
+    controller performs the clone/restart (tuner.py)."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        perturbation_interval: int = 4,
+        hyperparam_mutations: Dict = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        time_attr: str = "training_iteration",
+        seed: int = 0,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        if not hyperparam_mutations:
+            raise ValueError("hyperparam_mutations is required for PBT")
+        import random as _random
+
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations)
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.time_attr = time_attr
+        self._rng = _random.Random(seed)
+        self._configs: Dict[str, Dict] = {}
+        self._scores: Dict[str, float] = {}
+        self._last_perturb: Dict[str, int] = {}
+        self.exploit_count = 0  # observability / tests
+
+    # controller hooks ---------------------------------------------------
+
+    def on_trial_add(self, trial_id: str, config: Dict) -> None:
+        self._configs[trial_id] = dict(config)
+        self._last_perturb[trial_id] = 0
+
+    def _explore(self, config: Dict) -> Dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if isinstance(spec, list):
+                out[key] = self._rng.choice(spec)
+            elif callable(spec) and not hasattr(spec, "sample"):
+                out[key] = spec()
+            elif hasattr(spec, "sample"):  # search-space Domain
+                out[key] = spec.sample(self._rng)
+            elif isinstance(out.get(key), (int, float)):
+                factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                out[key] = type(out[key])(out[key] * factor)
+        return out
+
+    def on_result(self, trial_id: str, result: Dict):
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        self._scores[trial_id] = float(value)
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        peers = sorted(
+            self._scores.items(), key=lambda kv: kv[1],
+            reverse=(self.mode == "max"),
+        )
+        if len(peers) < 2:
+            return CONTINUE
+        k = max(1, int(len(peers) * self.quantile))
+        top = [tid for tid, _ in peers[:k]]
+        bottom = {tid for tid, _ in peers[-k:]}
+        if trial_id not in bottom or trial_id in top:
+            return CONTINUE
+        source = self._rng.choice(top)
+        base = self._configs.get(source, self._configs.get(trial_id, {}))
+        if self._rng.random() < self.resample_p:
+            new_config = self._explore(self._explore(base))
+        else:
+            new_config = self._explore(base)
+        self._configs[trial_id] = dict(new_config)
+        self.exploit_count += 1
+        return ("EXPLOIT", source, new_config)

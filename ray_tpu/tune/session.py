@@ -13,10 +13,19 @@ _local = threading.local()
 
 
 def _set(report_cb: Optional[Callable], trial_dir: Optional[str],
-         config: Optional[Dict[str, Any]]) -> None:
+         config: Optional[Dict[str, Any]],
+         restore_from: Optional[str] = None) -> None:
     _local.report_cb = report_cb
     _local.trial_dir = trial_dir
     _local.config = config
+    _local.restore_from = restore_from
+
+
+def get_checkpoint() -> Optional[str]:
+    """Checkpoint dir to resume from, if this trial was cloned (PBT
+    exploit) or restored; None for a fresh trial. Parity:
+    ray.tune.get_checkpoint."""
+    return getattr(_local, "restore_from", None)
 
 
 def report(metrics: Dict[str, Any],

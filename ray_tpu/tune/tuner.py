@@ -52,6 +52,7 @@ class TrialResult:
         self.error: Optional[str] = None
         self.stopped_early = False
         self.checkpoint_path: Optional[str] = None
+        self.exploited_from: Optional[str] = None  # PBT clone source
 
     def __repr__(self):
         return (
@@ -95,7 +96,8 @@ class ResultGrid:
 class _TrialRunner:
     """Hosts one trial; buffers reports for the controller to drain."""
 
-    def __init__(self, fn_blob: bytes, config: Dict[str, Any], trial_dir: str):
+    def __init__(self, fn_blob: bytes, config: Dict[str, Any], trial_dir: str,
+                 restore_from: Optional[str] = None):
         import threading
 
         from ray_tpu.tune import session
@@ -103,6 +105,7 @@ class _TrialRunner:
         self._fn = serialization.loads(fn_blob)
         self._config = config
         self._trial_dir = trial_dir
+        self._restore_from = restore_from
         self._reports: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._done = False
@@ -111,11 +114,20 @@ class _TrialRunner:
 
     def run(self) -> bool:
         """Executes the trainable to completion (or until killed)."""
-        from ray_tpu.tune import session
+        import inspect
 
-        session._set(self._on_report, self._trial_dir, self._config)
+        from ray_tpu.tune import session
+        from ray_tpu.tune.trainable import Trainable
+
+        session._set(
+            self._on_report, self._trial_dir, self._config,
+            restore_from=self._restore_from,
+        )
         try:
-            self._fn(self._config)
+            if inspect.isclass(self._fn) and issubclass(self._fn, Trainable):
+                self._run_class_trainable()
+            else:
+                self._fn(self._config)
             return True
         except Exception as e:  # noqa: BLE001
             with self._lock:
@@ -125,6 +137,34 @@ class _TrialRunner:
             with self._lock:
                 self._done = True
             session._set(None, None, None)
+
+    def _run_class_trainable(self) -> None:
+        """Drive a Trainable subclass: setup → step loop, reporting each
+        step with an auto-checkpoint (PBT exploits restore from these)."""
+        from ray_tpu.tune import session
+
+        inst = self._fn()
+        inst.config = dict(self._config)
+        inst.setup(self._config)
+        if self._restore_from is not None:
+            state = session.load_checkpoint(self._restore_from)
+            inst.load_checkpoint(state.get("trainable_state", state))
+            inst.iteration = state.get("_iteration", inst.iteration)
+        try:
+            while True:
+                metrics = inst.step() or {}
+                inst.iteration += 1
+                session.report(
+                    dict(metrics),
+                    checkpoint={
+                        "trainable_state": inst.save_checkpoint(),
+                        "_iteration": inst.iteration,
+                    },
+                )
+                if metrics.get("done"):
+                    return
+        finally:
+            inst.cleanup()
 
     def _on_report(self, metrics: Dict[str, Any]) -> None:
         with self._lock:
@@ -158,6 +198,15 @@ class Tuner:
             "/tmp/ray_tpu", "tune", f"run_{uuid.uuid4().hex[:8]}"
         )
 
+    def _latest_checkpoint(self, tid: str) -> Optional[str]:
+        trial_dir = os.path.join(self._run_dir, tid)
+        if not os.path.isdir(trial_dir):
+            return None
+        ckpts = sorted(
+            d for d in os.listdir(trial_dir) if d.startswith("checkpoint_")
+        )
+        return os.path.join(trial_dir, ckpts[-1]) if ckpts else None
+
     def fit(self) -> ResultGrid:
         cfg = self._cfg
         configs = generate_trials(
@@ -171,18 +220,41 @@ class Tuner:
         running: Dict[str, Dict[str, Any]] = {}  # tid -> {actor, run_ref}
         os.makedirs(self._run_dir, exist_ok=True)
 
-        def launch(tid: str, config: Dict[str, Any]) -> None:
+        from ray_tpu.tune.trainable import trial_resources
+
+        resources = trial_resources(self._trainable) or {}
+        if hasattr(cfg.scheduler, "on_trial_add"):
+            for tid, c in pending:
+                cfg.scheduler.on_trial_add(tid, c)
+
+        def launch(tid: str, config: Dict[str, Any],
+                   restore_from: Optional[str] = None,
+                   prev_iter: int = 0) -> None:
             trial_dir = os.path.join(self._run_dir, tid)
             os.makedirs(trial_dir, exist_ok=True)
             # max_concurrency=2: run() occupies one execution thread for
             # the trial's lifetime; drain() needs the other.
-            actor = _TrialRunner.options(max_concurrency=2).remote(
-                fn_blob, config, trial_dir
+            opts: Dict[str, Any] = {"max_concurrency": 2}
+            if resources:
+                cpus = resources.get("CPU")
+                if cpus is not None:
+                    opts["num_cpus"] = cpus
+                tpus = resources.get("TPU")
+                if tpus is not None:
+                    opts["num_tpus"] = tpus
+                custom = {
+                    k: v for k, v in resources.items()
+                    if k not in ("CPU", "TPU")
+                }
+                if custom:
+                    opts["resources"] = custom
+            actor = _TrialRunner.options(**opts).remote(
+                fn_blob, config, trial_dir, restore_from
             )
             running[tid] = {
                 "actor": actor,
                 "run_ref": actor.run.remote(),
-                "iter": 0,
+                "iter": prev_iter,
                 "cursor": 0,
             }
 
@@ -238,8 +310,30 @@ class Tuner:
                     res.all_reports.append(report)
                     res.metrics = report
                     decision = cfg.scheduler.on_result(tid, report)
-                    if decision == sched_mod.STOP:
+                    if decision != sched_mod.CONTINUE:
                         break
+                if isinstance(decision, tuple) and decision[0] == "EXPLOIT":
+                    # PBT: clone a top trial's checkpoint, restart with
+                    # mutated hyperparameters (reference pbt.py exploit)
+                    _, src_tid, new_config = decision
+                    src_ckpt = self._latest_checkpoint(src_tid)
+                    if src_ckpt is not None:
+                        logger.info(
+                            "PBT: trial %s exploits %s (new config %s)",
+                            tid, src_tid, new_config,
+                        )
+                        prev_iter = rec["iter"]
+                        rec_old = running.pop(tid)
+                        try:
+                            ray_tpu.kill(rec_old["actor"])
+                        except Exception:  # noqa: BLE001
+                            pass
+                        res.config = dict(new_config)
+                        res.exploited_from = src_tid
+                        launch(tid, new_config, restore_from=src_ckpt,
+                               prev_iter=prev_iter)
+                        continue
+                    decision = sched_mod.CONTINUE  # no ckpt yet: carry on
                 if state["done"] or state["error"]:
                     # drain any error; natural completion
                     finish(tid, error=state["error"])

@@ -139,3 +139,114 @@ def test_trial_error_reported(rt, tmp_path):
     results = tuner.fit()
     assert results.num_errors == 1
     assert results.get_best_result().metrics["score"] == 1
+
+
+def test_class_trainable(rt):
+    """Trainable subclass: setup/step/checkpoint loop (parity:
+    reference tune/trainable/)."""
+    from ray_tpu import tune
+
+    class Quad(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.total = 0.0
+
+        def step(self):
+            self.total += self.x
+            return {"score": self.total,
+                    "done": self.iteration >= 4}
+
+        def save_checkpoint(self):
+            return {"total": self.total}
+
+        def load_checkpoint(self, state):
+            self.total = state["total"]
+
+    tuner = tune.Tuner(
+        Quad,
+        param_space={"x": tune.grid_search([1.0, 3.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.config["x"] == 3.0
+    assert best.metrics["score"] == 15.0  # 5 steps x 3.0
+    assert best.checkpoint_path  # auto-checkpoints landed
+
+
+def test_pbt_exploit_mutates_config_mid_run(rt):
+    """PBT sweep: a losing trial clones a winner's checkpoint with a
+    mutated lr mid-run (VERDICT round-3 item 10)."""
+    from ray_tpu import tune
+
+    class LrTrial(tune.Trainable):
+        def setup(self, config):
+            self.lr = config["lr"]
+            self.weight = 0.0
+
+        def step(self):
+            import time
+
+            # good lr climbs fast; bad lr crawls — PBT should move the
+            # loser onto the winner's weights + a mutated lr. The sleep
+            # paces steps slower than the controller's poll period so
+            # perturbation decisions happen MID-run.
+            time.sleep(0.15)
+            self.weight += self.lr
+            return {"score": self.weight,
+                    "done": self.iteration >= 11}
+
+        def save_checkpoint(self):
+            return {"weight": self.weight}
+
+        def load_checkpoint(self, state):
+            self.weight = state["weight"]
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 1.0, 2.0]}, quantile_fraction=0.5,
+        seed=3,
+    )
+    tuner = tune.Tuner(
+        LrTrial,
+        param_space={"lr": tune.grid_search([0.01, 2.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", scheduler=pbt,
+            max_concurrent_trials=2,
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    assert pbt.exploit_count >= 1, "no PBT exploit happened"
+    exploited = [r for r in grid if r.exploited_from]
+    assert exploited, "no trial was cloned"
+    # the exploited trial now carries a mutated lr from the mutation set
+    assert exploited[0].config["lr"] in (0.1, 1.0, 2.0)
+    # and its weight jumped to the winner's trajectory: final score far
+    # above what lr=0.01 alone could reach (12 * 0.01)
+    assert exploited[0].metrics["score"] > 1.0
+
+
+def test_with_resources_per_trial(rt):
+    """Per-trial resource requests gate trial concurrency through the
+    scheduler (parity: tune.with_resources)."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        import time
+
+        time.sleep(0.2)
+        tune.report({"score": config["x"]})
+
+    wrapped = tune.with_resources(trainable, {"CPU": 2.0})
+    tuner = tune.Tuner(
+        wrapped,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3,
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 0
+    assert grid.get_best_result().metrics["score"] == 3
