@@ -36,6 +36,15 @@ class NodeProvider:
     def terminate_node(self, node_id: str) -> None:
         raise NotImplementedError
 
+    def node_resources(self) -> Optional[Dict[str, float]]:
+        """Resource shape of the node type this provider launches (the
+        bin-packing target; reference autoscaler/v2/scheduler.py matches
+        demand shapes to node types). None = unknown shape: providers
+        that don't declare one keep the pre-shape-aware behavior (all
+        demand counts as feasible) rather than having >1-CPU demand
+        silently classified infeasible."""
+        return None
+
     def shutdown(self) -> None:
         pass
 
@@ -70,6 +79,9 @@ class LocalNodeProvider(NodeProvider):
         except (ProcessLookupError, PermissionError):
             proc.terminate()
         logger.info("autoscaler terminated node %s", node_id[:8])
+
+    def node_resources(self) -> Dict[str, float]:
+        return dict(self.resources)
 
     def shutdown(self) -> None:
         for nid in list(self._procs):
@@ -126,6 +138,26 @@ class Autoscaler:
         finally:
             client.close()
 
+    def _publish_infeasible(
+        self, client: RpcClient, infeasible: List[Dict[str, float]],
+        tmpl: Dict[str, float],
+    ) -> None:
+        """Surface truly-unschedulable demand in the control store KV so
+        `rt status` can report it instead of the cluster silently scaling
+        (or never scaling)."""
+        if not infeasible:
+            return  # last report ages out (status filters by timestamp)
+        try:
+            client.call(
+                "kv_put", ns="autoscaler", key="infeasible",
+                value=json.dumps(
+                    {"shapes": infeasible, "node_type": tmpl,
+                     "ts": time.time()}
+                ).encode(),
+            )
+        except RpcError:
+            pass
+
     def _step(self, client: RpcClient) -> None:
         try:
             nodes = client.call("get_nodes", alive_only=True, timeout_s=10.0)
@@ -133,9 +165,33 @@ class Autoscaler:
             return
         n_alive = len(nodes)
         demand = sum(int(n.get("pending_leases", 0)) for n in nodes)
+        # Shape-aware demand (reference autoscaler/v2/scheduler.py
+        # bin-packs pending shapes into node types): upscale only when a
+        # pending shape would actually FIT the provider's node type —
+        # "any pending lease → +1 node" scaled to max_nodes forever on a
+        # task no node size could ever serve.
+        shapes: List[Dict[str, float]] = []
+        for n in nodes:
+            shapes.extend(n.get("pending_shapes") or [])
+        tmpl = self.provider.node_resources()
+        if tmpl is None:  # provider with an undeclared node shape
+            feasible, infeasible = list(shapes), []
+        else:
+            feasible = [
+                s for s in shapes
+                if all(tmpl.get(k, 0.0) >= v for k, v in s.items() if v > 0)
+            ]
+            infeasible = [
+                s for s in shapes
+                if not all(tmpl.get(k, 0.0) >= v for k, v in s.items() if v > 0)
+            ]
+        self._publish_infeasible(client, infeasible, tmpl)
+        # demand without shape info (older agents / flickering counters)
+        # counts as feasible — the pre-shape behavior
+        has_feasible_demand = bool(feasible) or (demand > 0 and not shapes)
         now = time.monotonic()
         if (
-            demand > 0
+            has_feasible_demand
             and n_alive < self.max_nodes
             and now - self._last_upscale >= self.upscale_cooldown_s
         ):
@@ -143,6 +199,7 @@ class Autoscaler:
             node_id = self.provider.create_node()
             self._launched.append(node_id)
             return
+        demand = demand if has_feasible_demand else 0
         # scale down: only nodes WE launched, newest first, when the whole
         # cluster has no demand and the node itself is idle
         alive_ids = {n["node_id"] for n in nodes}
