@@ -1,0 +1,137 @@
+// Native segment-IO core — the object-transfer data plane's pump.
+//
+// Parity role: the reference's object manager moves object chunks with a
+// dedicated C++ data path (src/ray/object_manager/object_manager.h,
+// push_manager.h) rather than through its control RPC stack; this is the
+// ray_tpu equivalent. The node agent serves whole-segment streams over a
+// raw TCP data port (sendfile, zero user-space copies) and the puller
+// receives straight into the destination buffer (one recv loop, no
+// per-chunk Python splicing). Python fallbacks (os.sendfile /
+// socket.recv_into) speak the identical protocol.
+//
+// Exported pumps release the GIL for their whole duration (ctypes).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Stream `len` bytes of in_fd starting at `offset` into out_fd (a
+// connected socket). Returns bytes sent (== len on success), or -errno.
+int64_t rt_sendfile_full(int out_fd, int in_fd, uint64_t offset,
+                         uint64_t len) {
+  off_t off = off_t(offset);
+  uint64_t sent = 0;
+  while (sent < len) {
+    ssize_t n = sendfile(out_fd, in_fd, &off, size_t(len - sent));
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      return -int64_t(errno);
+    }
+    if (n == 0) break;  // EOF: short file — caller surfaces as lost
+    sent += uint64_t(n);
+  }
+  return int64_t(sent);
+}
+
+// Receive exactly `len` bytes from sock_fd into buf. Returns bytes
+// received (== len on success; less on orderly EOF), or -errno.
+int64_t rt_recv_full(int sock_fd, uint8_t* buf, uint64_t len) {
+  uint64_t got = 0;
+  while (got < len) {
+    ssize_t n = recv(sock_fd, buf + got, size_t(len - got), 0);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -int64_t(errno);
+    }
+    if (n == 0) break;  // peer closed
+    got += uint64_t(n);
+  }
+  return int64_t(got);
+}
+
+// xxHash64 (Yann Collet's algorithm, reimplemented from the public
+// specification) — content addressing / integrity for stored segments.
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static inline uint64_t round1(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl(acc, 31);
+  return acc * P1;
+}
+
+static inline uint64_t merge(uint64_t acc, uint64_t val) {
+  acc ^= round1(0, val);
+  return acc * P1 + P4;
+}
+
+uint64_t rt_xxh64(const uint8_t* data, uint64_t len, uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+             v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = round1(v1, read64(p)); p += 8;
+      v2 = round1(v2, read64(p)); p += 8;
+      v3 = round1(v3, read64(p)); p += 8;
+      v4 = round1(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18);
+    h = merge(h, v1); h = merge(h, v2); h = merge(h, v3); h = merge(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += len;
+  while (p + 8 <= end) {
+    h ^= round1(0, read64(p));
+    h = rotl(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= uint64_t(read32(p)) * P1;
+    h = rotl(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= uint64_t(*p) * P5;
+    h = rotl(h, 11) * P1;
+    ++p;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+}  // extern "C"

@@ -57,6 +57,27 @@ class ShmChannel:
     def __init__(self, path: str, capacity: int, create: bool = False):
         self.path = path
         self.capacity = capacity
+        # Native core (C++ seqlock + futex handoff, native/src/
+        # channel_core.cpp): same shm layout, so native and Python peers
+        # interoperate; Python below is the fallback tier.
+        self._native = None
+        self._nbuf = None
+        from ray_tpu import native as native_mod
+
+        lib = native_mod.channel_lib()
+        if lib is not None:
+            import ctypes
+
+            handle = ctypes.c_void_p()
+            rc = lib.rt_chan_open(
+                path.encode(), capacity, 1 if create else 0,
+                ctypes.byref(handle),
+            )
+            if rc == 0:
+                self._native = (lib, handle)
+                self._nbuf = ctypes.create_string_buffer(capacity)
+                return
+            raise OSError(-rc, f"rt_chan_open({path!r}) failed")
         total = _HDR.size + capacity
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
         fd = os.open(path, flags, 0o600)
@@ -140,6 +161,20 @@ class ShmChannel:
             raise ValueError(
                 f"payload {len(payload)} > channel capacity {self.capacity}"
             )
+        if self._native is not None:
+            lib, handle = self._native
+            rc = lib.rt_chan_write(
+                handle, payload, len(payload),
+                -1.0 if timeout_s is None else float(timeout_s),
+            )
+            if rc == -1:
+                raise TimeoutError(
+                    f"channel {self.path}: reader never consumed the "
+                    "previous message"
+                )
+            if rc != 0:
+                raise ValueError(f"channel {self.path}: write error {rc}")
+            return
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         seq = self._u64(0)
         # flow control: previous message must have been consumed
@@ -157,6 +192,21 @@ class ShmChannel:
 
     def read(self, timeout_s: Optional[float] = 30.0) -> bytes:
         """Block until a message newer than the last one read arrives."""
+        if self._native is not None:
+            lib, handle = self._native
+            n = lib.rt_chan_read(
+                handle, self._nbuf, self.capacity,
+                -1.0 if timeout_s is None else float(timeout_s),
+            )
+            if n == -1:
+                raise TimeoutError(f"channel {self.path}: no message")
+            if n < 0:
+                raise ValueError(f"channel {self.path}: read error {n}")
+            import ctypes
+
+            # string_at copies exactly n bytes (.raw would copy the whole
+            # capacity-sized buffer per read — catastrophic at 4 MiB)
+            return ctypes.string_at(self._nbuf, int(n))
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         self._await(
             lambda: self._u64(0) > self._last_read, self._dbell, deadline,
@@ -171,15 +221,20 @@ class ShmChannel:
         return data
 
     def close(self, unlink: bool = False) -> None:
-        try:
-            self._mm.close()
-        except (BufferError, ValueError):
-            pass
-        for fd in (self._dbell, self._abell):
+        if self._native is not None:
+            lib, handle = self._native
+            self._native = None
+            lib.rt_chan_close(handle)
+        else:
             try:
-                os.close(fd)
-            except OSError:
+                self._mm.close()
+            except (BufferError, ValueError):
                 pass
+            for fd in (self._dbell, self._abell):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
         if unlink:
             for p in (self.path, self.path + ".d", self.path + ".a"):
                 try:

@@ -1,0 +1,127 @@
+"""Native-tier loader: builds and binds the C++ cores via ctypes.
+
+The runtime's hot data-plane paths (channel seqlock + futex handoff,
+object-segment IO) are C++ (native/src/*.cpp), mirroring the reference's
+native tier (its channel/object plane lives in src/ray/core_worker and
+src/ray/object_manager). Python implementations remain as wire- and
+layout-compatible fallbacks so the framework still runs where a
+toolchain is unavailable (RT_NATIVE=0 forces the fallback).
+
+The .so is built on demand with g++ -O3 and cached next to the sources;
+a content hash of the .cpp keys the cache so edits rebuild.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC_DIR = os.path.join(_REPO_ROOT, "native", "src")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+
+_lock = threading.Lock()
+_libs: dict = {}
+
+
+def _build(name: str) -> str | None:
+    """Compile native/src/<name>.cpp → native/build/<name>-<hash>.so."""
+    src = os.path.join(_SRC_DIR, f"{name}.cpp")
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:12]
+    out = os.path.join(_BUILD_DIR, f"{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-fno-exceptions", src, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build of %s unavailable: %s", name, e)
+        return None
+    if proc.returncode != 0:
+        logger.warning(
+            "native build of %s failed:\n%s", name, proc.stderr[-2000:]
+        )
+        return None
+    os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    return out
+
+
+def load(name: str) -> ctypes.CDLL | None:
+    """Load (building if needed) a native core; None → use the fallback."""
+    if os.environ.get("RT_NATIVE", "1") == "0":
+        return None
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        lib = None
+        path = _build(name)
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError as e:
+                logger.warning("native %s load failed: %s", name, e)
+        _libs[name] = lib
+        return lib
+
+
+def store_lib() -> ctypes.CDLL | None:
+    lib = load("store_core")
+    if lib is None:
+        return None
+    if not getattr(lib, "_rt_sigs_set", False):
+        lib.rt_sendfile_full.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.rt_sendfile_full.restype = ctypes.c_int64
+        lib.rt_recv_full.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.rt_recv_full.restype = ctypes.c_int64
+        lib.rt_xxh64.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+        ]
+        lib.rt_xxh64.restype = ctypes.c_uint64
+        lib._rt_sigs_set = True
+    return lib
+
+
+def channel_lib() -> ctypes.CDLL | None:
+    lib = load("channel_core")
+    if lib is None:
+        return None
+    if not getattr(lib, "_rt_sigs_set", False):
+        lib.rt_chan_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.rt_chan_open.restype = ctypes.c_int
+        lib.rt_chan_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_double,
+        ]
+        lib.rt_chan_write.restype = ctypes.c_int
+        lib.rt_chan_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_double,
+        ]
+        lib.rt_chan_read.restype = ctypes.c_int64
+        lib.rt_chan_close.argtypes = [ctypes.c_void_p]
+        lib.rt_chan_close.restype = None
+        lib._rt_sigs_set = True
+    return lib
