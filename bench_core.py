@@ -132,6 +132,35 @@ def main():
     lat_ms = (time.perf_counter() - t0) / loops * 1e3
     record("wait_ready_latency_ms", lat_ms, "ms")
 
+    # -- object transfer: streamed data plane vs chunked RPC pulls ------
+    # (the segment lives in this host's agent store; the pull path is the
+    # same one cross-node gets take — sendfile stream with chunked-RPC
+    # fallback, worker.py _pull_remote_segment)
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    seg_ref = ray_tpu.put(np.zeros(32 * 1024 * 1024, dtype=np.uint8))
+    stored = w.memory_store.try_get(seg_ref.id)
+    if hasattr(stored, "path"):
+        mb = stored.size / 2**20
+        buf = bytearray(stored.size)
+        if w._pull_via_data_plane(
+            stored.path, stored.size, stored.agent_address, buf
+        ):
+            per_s, lat = timed(lambda: w._pull_via_data_plane(
+                stored.path, stored.size, stored.agent_address, buf
+            ), 10, warmup=2)
+            record("segment_stream_32mb", mb / lat, "MiB/s")
+        # chunked-RPC fallback path, forced by disabling the data port
+        w._data_ports[stored.agent_address] = (0, time.monotonic())
+        try:
+            per_s, lat = timed(lambda: w._pull_remote_segment(
+                stored.path, stored.size, stored.agent_address
+            ), 5, warmup=1)
+            record("segment_chunked_rpc_32mb", mb / lat, "MiB/s")
+        finally:
+            w._data_ports.pop(stored.agent_address, None)
+
     # -- compiled DAG vs RPC path --------------------------------------
     from ray_tpu.dag import InputNode
 

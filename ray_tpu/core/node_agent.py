@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import logging
 import os
+import socket
+import struct
 import subprocess
 import sys
 import threading
@@ -97,6 +99,9 @@ class NodeAgent:
         # resource shapes recently starved for (shape key -> last seen):
         # heartbeats report entries younger than the TTL
         self._starved_shapes: Dict[tuple, float] = {}
+        # versioned-sync counters (observability for the delta protocol)
+        self._hb_full = 0
+        self._hb_light = 0
 
         self.temp_dir = temp_dir or os.path.join(
             config.temp_dir, f"session_{session_id[:8]}"
@@ -112,6 +117,12 @@ class NodeAgent:
         self._control = RpcClient(control_address, name="agent->cs")
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
+        # Data-plane listener (object transfer): whole segments stream
+        # over a raw TCP socket via sendfile — the control RPC stack never
+        # carries bulk object bytes (parity: reference object manager's
+        # dedicated data port, src/ray/object_manager/object_manager.h).
+        self._data_sock: Optional[socket.socket] = None
+        self.data_port = 0
         # True when this agent is the whole process (node_main): being
         # declared dead exits the process; in-head agents just stop.
         self.standalone = False
@@ -126,6 +137,7 @@ class NodeAgent:
 
     def start(self) -> None:
         self._server.start()
+        self._start_data_server()
         reply = self._control.call(
             "register_node",
             node_info={
@@ -156,11 +168,138 @@ class NodeAgent:
             self._workers.clear()
         for w in workers:
             self._terminate_worker(w)
+        if self._data_sock is not None:
+            try:
+                self._data_sock.close()
+            except OSError:
+                pass
         self._server.stop()
         self._control.close()
         self.store.shutdown()
 
+    # ------------------------------------------------------------------
+    # data plane: whole-segment streaming over a raw TCP port (parity:
+    # reference object manager's dedicated data port + chunked transfer,
+    # src/ray/object_manager/object_manager.h — here one request streams
+    # the whole segment via sendfile; native/src/store_core.cpp pumps it,
+    # os.sendfile is the fallback)
+    # ------------------------------------------------------------------
+
+    _DATA_LOST = 0xFFFFFFFFFFFFFFFF
+
+    def _start_data_server(self) -> None:
+        try:
+            host = self.address.rsplit(":", 1)[0]
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((host, 0))
+            sock.listen(64)
+        except OSError as e:
+            # port-restricted environment: the node stays fully functional
+            # on the chunked-RPC path (data_port=0 advertises exactly that)
+            logger.warning("data-plane listener unavailable: %s", e)
+            self.data_port = 0
+            return
+        self._data_sock = sock
+        self.data_port = sock.getsockname()[1]
+        t = threading.Thread(
+            target=self._data_accept_loop, name="agent-data", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+
+    def _data_accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._data_sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_data_conn, args=(conn,),
+                name="agent-data-conn", daemon=True,
+            ).start()
+
+    def _serve_data_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hdr = self._recv_exact(conn, 4)
+            if hdr is None:
+                return
+            (path_len,) = struct.unpack("<I", hdr)
+            if path_len > 4096:
+                return
+            req = self._recv_exact(conn, path_len + 16)
+            if req is None:
+                return
+            path = req[:path_len].decode()
+            offset, length = struct.unpack("<QQ", req[path_len:])
+            try:
+                opened = self.store.open_for_read(path)
+            except ValueError:
+                opened = None
+            if opened is None:
+                conn.sendall(struct.pack("<Q", self._DATA_LOST))
+                return
+            fd, size = opened
+            try:
+                if offset >= size:
+                    conn.sendall(struct.pack("<Q", 0))
+                    return
+                total = min(length, size - offset)
+                conn.sendall(struct.pack("<Q", total))
+                from ray_tpu import native as native_mod
+
+                lib = native_mod.store_lib()
+                if lib is not None:
+                    sent = lib.rt_sendfile_full(
+                        conn.fileno(), fd, offset, total
+                    )
+                    if sent != total:
+                        return  # peer gone or file truncated: drop conn
+                else:
+                    off = offset
+                    remaining = total
+                    while remaining > 0:
+                        n = os.sendfile(
+                            conn.fileno(), fd, off, min(remaining, 1 << 22)
+                        )
+                        if n <= 0:
+                            return
+                        off += n
+                        remaining -= n
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            part = conn.recv(n - len(buf))
+            if not part:
+                return None
+            buf += part
+        return buf
+
+    def rpc_get_data_port(self, conn):
+        return self.data_port
+
     def _heartbeat_loop(self) -> None:
+        # Versioned resource-view sync (reference ray_syncer.h:91 delta
+        # protocol): a heartbeat carries the full resource payload only
+        # when it CHANGED since the last acked beat (or as a periodic
+        # anti-entropy refresh); unchanged beats are a light liveness ping
+        # with the last version, so steady-state control-plane traffic is
+        # O(nodes), not O(nodes x resource-dict size).
+        last_sent = None
+        version = 0
+        since_full = 0
         while not self._stopped.wait(config.health_check_period_s):
             with self._lock:
                 avail = dict(self.resources_available)
@@ -171,28 +310,50 @@ class NodeAgent:
                     if now - ts > 5.0:
                         del self._starved_shapes[k]
                 shapes = [dict(k) for k in self._starved_shapes]
+            payload = (tuple(sorted(avail.items())), pending, busy,
+                       tuple(tuple(sorted(s.items())) for s in shapes))
+            unchanged = payload == last_sent and since_full < 30
             try:
+                if unchanged:
+                    since_full += 1
+                    self._hb_light += 1
+                    reply = self._control.call(
+                        "heartbeat", node_id=self.node_id.hex(),
+                        resources_available=None, timeout_s=5.0,
+                        view_version=version,
+                    )
+                    if reply.get("resync"):
+                        last_sent = None  # store lost our view: full next
+                    if not reply.get("ok"):
+                        self._declared_dead()
+                        return
+                    continue
+                version += 1
+                since_full = 0
+                self._hb_full += 1
                 reply = self._control.call(
                     "heartbeat", node_id=self.node_id.hex(),
                     resources_available=avail, timeout_s=5.0,
                     pending_leases=pending, active_leases=busy,
-                    extra={"pending_shapes": shapes},
+                    extra={"pending_shapes": shapes}, view_version=version,
                 )
+                last_sent = payload
                 if not reply.get("ok"):
-                    # Declared dead by the control plane: our actors may
-                    # already be restarting elsewhere. Tear down (killing
-                    # all local workers) so no split-brain actor instance
-                    # keeps serving (reference: raylets exit when GCS
-                    # declares them dead).
-                    logger.warning(
-                        "control store declared this node dead; shutting down"
-                    )
-                    self.stop()
-                    if self.standalone:
-                        os._exit(1)
+                    self._declared_dead()
                     return
             except RpcError:
-                pass
+                # the beat may not have landed: resend a full view next
+                last_sent = None
+
+    def _declared_dead(self) -> None:
+        """Declared dead by the control plane: our actors may already be
+        restarting elsewhere. Tear down (killing all local workers) so no
+        split-brain actor instance keeps serving (reference: raylets exit
+        when GCS declares them dead)."""
+        logger.warning("control store declared this node dead; shutting down")
+        self.stop()
+        if self.standalone:
+            os._exit(1)
 
     # ------------------------------------------------------------------
     # memory monitor / OOM killer (reference C19: MemoryMonitor
@@ -798,6 +959,9 @@ class NodeAgent:
                 },
                 "store_usage": self.store.usage(),
                 "spill_stats": self.store.spill_stats(),
+                "heartbeat_stats": {
+                    "full": self._hb_full, "light": self._hb_light,
+                },
             }
 
 

@@ -303,16 +303,18 @@ class ShmObjectStore:
                 except OSError:
                     pass
 
-    def read_chunk(self, path: str, offset: int, length: int) -> Optional[bytes]:
-        """Read a byte range of a sealed segment (serving cross-node pulls).
+    def _resolve_sealed(self, path: str) -> Optional[Tuple[str, int]]:
+        """Resolve a segment path to (currently-backing file, size).
 
         Only segments actually created by this store are readable: a bare
         prefix check would let a crafted '<prefix>x/../../etc/passwd' path
         escape, so resolve the path and require it to name a tracked
         object (O(1): the oid is the path suffix). A well-formed path whose
-        object was deleted mid-transfer returns None — the puller maps that
-        to ObjectLostError, same as a vanished segment. Spilled objects
-        serve straight from the spill file without restoring."""
+        object was deleted mid-transfer returns None — readers map that to
+        ObjectLostError, same as a vanished segment. Spilled objects serve
+        straight from the spill file without restoring; an in-flight
+        spill/restore is waited out (reading a path that is about to be
+        unlinked would misreport a live object as lost)."""
         real = os.path.realpath(path)
         base = os.path.basename(real)
         marker = self._base_prefix + "_"
@@ -321,15 +323,24 @@ class ShmObjectStore:
         oid_hex = base[len(marker):]
         with self._lock:
             entry = self._objects.get(oid_hex)
-            # wait out an in-flight spill/restore: reading a path that is
-            # about to be unlinked would misreport a live object as lost
             while entry is not None and entry.state in ("spilling", "restoring"):
                 self._sealed_cv.wait(1.0)
                 entry = self._objects.get(oid_hex)
             if entry is None or not entry.sealed:
                 return None  # deleted (or never sealed): lost, not an attack
             entry.last_access = time.monotonic()
-            read_path = entry.path if entry.in_shm else entry.spill_path
+            return (
+                entry.path if entry.in_shm else entry.spill_path,
+                entry.size,
+            )
+
+    def read_chunk(self, path: str, offset: int, length: int) -> Optional[bytes]:
+        """Read a byte range of a sealed segment (serving the chunked-RPC
+        fallback of cross-node pulls)."""
+        resolved = self._resolve_sealed(path)
+        if resolved is None:
+            return None
+        read_path, _ = resolved
         try:
             fd = os.open(read_path, os.O_RDONLY)
         except OSError:
@@ -347,6 +358,20 @@ class ShmObjectStore:
             return b"".join(parts)
         finally:
             os.close(fd)
+
+    def open_for_read(self, path: str) -> Optional[Tuple[int, int]]:
+        """Open the file currently backing a sealed segment for streaming
+        (the data-plane server, node_agent._serve_data_conn). Returns
+        (fd, size) or None when the object is gone; the open fd keeps the
+        bytes alive across a concurrent spill's unlink (POSIX)."""
+        resolved = self._resolve_sealed(path)
+        if resolved is None:
+            return None
+        read_path, size = resolved
+        try:
+            return os.open(read_path, os.O_RDONLY), size
+        except OSError:
+            return None
 
     def usage(self) -> Tuple[int, int]:
         with self._lock:

@@ -29,6 +29,20 @@ _extracted: Dict[str, str] = {}  # digest -> extracted path
 _uploaded: Dict[str, str] = {}  # abs working_dir path -> digest
 
 
+def _content_digest(blob: bytes) -> str:
+    """Content address for working_dir packages: native xxHash64
+    (native/src/store_core.cpp — same role as the reference's package
+    hashing in runtime_env packaging) with a sha1 fallback. The two never
+    mix within one cluster: keys are generated on the driver and looked
+    up verbatim."""
+    from ray_tpu import native
+
+    lib = native.store_lib()
+    if lib is not None:
+        return f"xxh64-{lib.rt_xxh64(blob, len(blob), 0):016x}"
+    return hashlib.sha1(blob).hexdigest()
+
+
 def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str, Any]]:
     """Driver-side: normalize + upload. working_dir paths become
     content-addressed KV references, uploaded ONCE per directory path per
@@ -68,7 +82,7 @@ def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str
                         )
                     zf.write(path, os.path.relpath(path, wd))
         blob = buf.getvalue()
-        digest = hashlib.sha1(blob).hexdigest()
+        digest = _content_digest(blob)
         control.call(
             "kv_put", ns=_KV_NS, key=digest, value=blob, overwrite=False,
             retryable=True,

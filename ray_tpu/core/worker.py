@@ -346,6 +346,9 @@ class CoreWorker:
 
         self.memory_store = MemoryStore()
         self.shm = ShmClient()
+        # data-plane port cache per agent: addr -> (port, fetched_at);
+        # entries expire so an agent restart gets re-discovered
+        self._data_ports: Dict[str, Tuple[int, float]] = {}
         # TPU-RDT: lazily-built store of device-resident pytrees this
         # process produced under tensor_transport="device"
         self._device_store = None
@@ -769,6 +772,14 @@ class CoreWorker:
             buf: Any = mm
         else:
             buf = bytearray(size)
+        # Data plane first: one raw-TCP request streams the whole segment
+        # (agent-side sendfile, native recv pump) — the chunked RPC pull
+        # below is the fallback when the agent predates the data port or
+        # the stream breaks mid-flight.
+        if size > 0 and self._pull_via_data_plane(
+            path, size, agent_address, buf
+        ):
+            return memoryview(buf)
         offsets = list(range(0, size, chunk))
         inflight: "OrderedDict[int, Any]" = OrderedDict()
         next_idx = 0
@@ -796,6 +807,89 @@ class CoreWorker:
             done += 1
         return memoryview(buf)  # no copy; unpack accepts buffer views
 
+    _DATA_LOST = 0xFFFFFFFFFFFFFFFF
+
+    def _pull_via_data_plane(
+        self, path: str, size: int, agent_address: str, buf
+    ) -> bool:
+        """Stream the whole segment over the agent's data port into
+        ``buf``. True on success; False falls back to the chunked RPC
+        pull. Raises ObjectLostError when the holder reports the object
+        gone (the fallback would fail identically)."""
+        import socket
+        import struct
+
+        cached = self._data_ports.get(agent_address)
+        if cached is not None and time.monotonic() - cached[1] > 60.0:
+            cached = None  # stale: agent may have restarted with a new port
+        if cached is None:
+            try:
+                port = int(self.agents.get(agent_address).call(
+                    "get_data_port", timeout_s=10.0
+                ) or 0)
+            except RpcError:
+                # transient: fall back THIS pull, ask again next time
+                return False
+            cached = (port, time.monotonic())
+            self._data_ports[agent_address] = cached
+        port = cached[0]
+        if not port:
+            return False
+        host = agent_address.rsplit(":", 1)[0]
+        try:
+            with socket.create_connection((host, port), timeout=5.0) as s:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # kernel-level receive timeout: the native pump blocks in
+                # recv(2) without Python's non-blocking timeout machinery
+                s.settimeout(None)
+                s.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_RCVTIMEO,
+                    struct.pack("@ll", 120, 0),
+                )
+                p = path.encode()
+                s.sendall(
+                    struct.pack("<I", len(p)) + p
+                    + struct.pack("<QQ", 0, size)
+                )
+                hdr = b""
+                while len(hdr) < 8:
+                    part = s.recv(8 - len(hdr))
+                    if not part:
+                        return False
+                    hdr += part
+                (total,) = struct.unpack("<Q", hdr)
+                if total == self._DATA_LOST:
+                    raise ObjectLostError(
+                        f"remote segment {path} vanished during transfer"
+                    )
+                if total != size:
+                    return False  # truncated view: let the fallback decide
+                from ray_tpu import native as native_mod
+
+                lib = native_mod.store_lib()
+                if lib is not None:
+                    import ctypes
+
+                    cbuf = (ctypes.c_char * size).from_buffer(buf)
+                    got = lib.rt_recv_full(
+                        s.fileno(), ctypes.addressof(cbuf), size
+                    )
+                    del cbuf
+                else:
+                    view = memoryview(buf)
+                    got = 0
+                    while got < size:
+                        n = s.recv_into(view[got:], size - got)
+                        if n <= 0:
+                            break
+                        got += n
+                return got == size
+        except OSError:
+            # broken stream or dead port: drop the cache entry so the next
+            # pull re-discovers instead of re-dialing a corpse
+            self._data_ports.pop(agent_address, None)
+            return False
+
     def wait(
         self,
         refs: List[ObjectRef],
@@ -819,7 +913,8 @@ class CoreWorker:
                 present = self.memory_store.wait_newly_present(
                     [r.id for r in local], known, remaining
                 )
-                ready = [r for r in local if r.id in set(present)]
+                present_set = set(present)
+                ready = [r for r in local if r.id in present_set]
                 if len(ready) >= num_returns or len(ready) == len(local):
                     break
                 if deadline is not None and time.monotonic() >= deadline:
