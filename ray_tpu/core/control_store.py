@@ -78,6 +78,10 @@ class ControlStore:
         # pubsub: topic -> {conn_id: conn}
         self._subs: Dict[str, Dict[int, Any]] = {}
 
+        # aggregate resource-view version: bumps on any node join/leave or
+        # resource change (versioned sync, reference ray_syncer.h:91)
+        self._view_version = 0
+
         self._agents = ClientPool("cs->agent")
         self._workers = ClientPool("cs->worker")
         self._stopped = threading.Event()
@@ -322,15 +326,23 @@ class ControlStore:
                 "last_heartbeat": time.monotonic(),
                 "resources_available": dict(node_info["resources_total"]),
             }
+            self._view_version += 1
         logger.info("node %s registered at %s", node_id[:8], node_info["address"])
         self.publish("node", {"event": "added", "node": self._public_node(node_id)})
         # fresh capacity: retry anything the scheduler had parked
         self._sched_enqueue(("kick",))
         return {"config_snapshot": config.snapshot(), "session_id": self.session_id}
 
-    def rpc_heartbeat(self, conn, node_id: str, resources_available: Dict[str, float],
+    def rpc_heartbeat(self, conn, node_id: str,
+                      resources_available: Optional[Dict[str, float]] = None,
                       extra: Optional[Dict[str, Any]] = None,
-                      pending_leases: int = 0, active_leases: int = 0):
+                      pending_leases: int = 0, active_leases: int = 0,
+                      view_version: Optional[int] = None):
+        """Versioned resource-view sync (reference ray_syncer.h:91):
+        resources_available=None is a LIGHT beat — liveness only, the
+        resource view is unchanged at `view_version`. A version mismatch
+        (store restarted / payload lost) asks the agent to resync with a
+        full beat."""
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node["alive"]:
@@ -340,11 +352,17 @@ class ControlStore:
             # break as a death signal, not just missed heartbeats).
             conn.node_id = node_id
             node["last_heartbeat"] = time.monotonic()
+            if resources_available is None:
+                if node.get("view_version") != view_version:
+                    return {"ok": True, "resync": True}
+                return {"ok": True}
             node["resources_available"] = resources_available
             node["pending_leases"] = pending_leases
             node["active_leases"] = active_leases
+            node["view_version"] = view_version
             if extra:
                 node.update(extra)
+            self._view_version += 1
         return {"ok": True}
 
     def rpc_get_nodes(self, conn, alive_only: bool = True):
@@ -355,9 +373,19 @@ class ControlStore:
                 if n["alive"] or not alive_only
             ]
 
-    def rpc_get_cluster_view(self, conn):
-        """Scheduling view: per-node totals/availables (syncer equivalent)."""
+    def rpc_get_cluster_view(self, conn, known_version: Optional[int] = None):
+        """Scheduling view: per-node totals/availables (syncer
+        equivalent). With known_version, reply {"unchanged": True} when
+        the aggregate view hasn't moved — consumers polling the view
+        (autoscaler, elastic train) pay O(1) instead of O(nodes)."""
         with self._lock:
+            if known_version is not None:
+                if known_version == self._view_version:
+                    return {"unchanged": True, "version": self._view_version}
+                return {
+                    "version": self._view_version,
+                    "view": self._cluster_view_locked(),
+                }
             return self._cluster_view_locked()
 
     def rpc_drain_node(self, conn, node_id: str):
@@ -395,6 +423,7 @@ class ControlStore:
             if node is None or not node["alive"]:
                 return
             node["alive"] = False
+            self._view_version += 1
             affected_actors = [
                 a for a in self._actors.values()
                 if a.get("node_id") == node_id
@@ -543,7 +572,12 @@ class ControlStore:
             try:
                 self._process_sched(item)
             except Exception:  # noqa: BLE001 — scheduler must survive
-                logger.exception("scheduler item %s failed", item[:1])
+                logger.exception("scheduler item %r failed", item)
+                # never DROP a pending entity on a scheduling crash: retry
+                # with the key's backoff (capped), so a transient error
+                # (node died mid-pass) can't orphan an actor/PG forever
+                if item and item[0] in ("actor", "pg"):
+                    self._sched_retry(item, tuple(item[:2]))
 
     def _process_sched(self, item: tuple) -> None:
         kind = item[0]
@@ -595,7 +629,10 @@ class ControlStore:
         node_id = scheduling.pick_node(
             view, resources, strategy, self._pgs, self._lock
         )
-        if node_id is None:
+        if node_id is None or node_id not in view:
+            # not in view: a PG-bundle pick can name a node that died
+            # after the snapshot — retry (the PG re-places its bundle)
+            # rather than KeyError-ing the item out of the queue
             self._sched_retry(("actor", actor_id), ("actor", actor_id))
             return
         agent_addr = view[node_id]["address"]

@@ -39,6 +39,7 @@ class TrainController:
         self.run_dir = run_dir
         self.max_failures = max_failures
         self._resize_hint: Optional[int] = None
+        self._view_cache: tuple = (-1, {})
         self.ckpts = CheckpointManager(
             run_dir, num_to_keep=num_to_keep,
             score_attribute=score_attribute, score_order=score_order,
@@ -155,14 +156,23 @@ class TrainController:
 
     def _feasible_workers(self) -> int:
         """How many workers the cluster's AVAILABLE resources could host
-        right now (per-node bin-packing of worker_resources)."""
+        right now (per-node bin-packing of worker_resources). Uses the
+        versioned view protocol: an unchanged cluster costs O(1) on the
+        wire, not a full per-node resource dump per poll."""
         from ray_tpu.core import worker as worker_mod
 
         req = self.scaling.worker_resources()
+        cached_version, cached_view = self._view_cache
         try:
-            view = worker_mod.global_worker().control.call(
-                "get_cluster_view", timeout_s=10.0
+            reply = worker_mod.global_worker().control.call(
+                "get_cluster_view", known_version=cached_version,
+                timeout_s=10.0,
             )
+            if reply.get("unchanged"):
+                view = cached_view
+            else:
+                view = reply["view"]
+                self._view_cache = (reply["version"], view)
         except Exception:  # noqa: BLE001
             return 0
         total = 0
