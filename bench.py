@@ -144,6 +144,14 @@ def main() -> None:
             "backend": jax.default_backend(),
             "device": jax.devices()[0].device_kind,
             "input": "ray_tpu.data streaming pipeline",
+            "baseline_note": (
+                "vs_baseline = MFU / 0.40 (an efficient DDP/NCCL GPT-2 "
+                "pretrain's typical MFU; the reference publishes no "
+                "tokens/sec). BASELINE.json's north star — scaling "
+                "efficiency 8->256 chips — cannot be measured on the one "
+                "chip this harness provides; the multi-chip sharding path "
+                "is exercised by dryrun_multichip instead."
+            ),
         },
     }))
 

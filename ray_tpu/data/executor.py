@@ -403,12 +403,13 @@ class StreamingExecutor:
                     except queue.Full:
                         break
                 # submit work, downstream-most first (drains the pipeline,
-                # bounding memory — the reference's selection policy)
+                # bounding memory — the reference's selection policy).
+                # Fill EVERY op's window each pass: one-submission-per-pass
+                # capped the whole pipeline at ~200 tasks/s (round-3 debt).
                 for op in reversed(ops):
-                    if op.can_submit():
+                    while op.can_submit():
                         op.submit_one()
                         progressed = True
-                        break
                 if all(op.done() for op in ops) and not any(
                     op.outputs for op in ops
                 ):

@@ -33,7 +33,7 @@ class BaseID:
     """Immutable binary id with hex round-tripping."""
 
     SIZE = 0
-    __slots__ = ("_binary",)
+    __slots__ = ("_binary", "_hash")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
@@ -41,6 +41,10 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
             )
         object.__setattr__(self, "_binary", binary)
+        # ids are dict/set keys on every hot path: hash once
+        object.__setattr__(
+            self, "_hash", hash((type(self).__name__, binary))
+        )
 
     def __setattr__(self, name, value):  # immutability
         raise AttributeError(f"{type(self).__name__} is immutable")
@@ -70,7 +74,7 @@ class BaseID:
         return type(other) is type(self) and other._binary == self._binary
 
     def __hash__(self):
-        return hash((type(self).__name__, self._binary))
+        return self._hash
 
     def __repr__(self):
         return f"{type(self).__name__}({self.hex()})"
