@@ -22,10 +22,11 @@ _ACTOR_OPTION_KEYS = {
 }
 
 
-def method(num_returns: int = 1, tensor_transport: str = "object"):
+def method(num_returns=1, tensor_transport: str = "object"):
     """Decorator configuring an actor method (parity: ray.method —
     including the RDT ``tensor_transport`` option, reference
-    gpu_object_manager.py: ``@ray.method(tensor_transport=...)``)."""
+    gpu_object_manager.py, and ``num_returns="streaming"`` for generator
+    methods that yield through an ObjectRefGenerator)."""
 
     from ray_tpu.core.device_objects import validate_transport
 
@@ -132,12 +133,13 @@ class ActorMethod:
         from ray_tpu.core import worker as worker_mod
 
         w = worker_mod.global_worker()
+        nr = -1 if self._num_returns == "streaming" else self._num_returns
         refs = w.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns,
+            num_returns=nr,
             tensor_transport=self._tensor_transport,
         )
-        if self._num_returns == 1:
+        if nr in (1, -1):  # single ref, or the ObjectRefGenerator
             return refs[0]
         return refs
 

@@ -1660,10 +1660,15 @@ class CoreWorker:
                           num_returns: int = 1,
                           tensor_transport: str = "object") -> List[ObjectRef]:
         task_id = TaskID.for_actor_task(ActorID.from_hex(actor_id))
-        refs = [
-            ObjectRef(ObjectID.from_task(task_id, i), self.address)
-            for i in range(num_returns)
-        ]
+        if num_returns == -1:  # streaming actor method (generator)
+            from ray_tpu.core.object_ref import ObjectRefGenerator
+
+            refs: List[Any] = [ObjectRefGenerator(task_id, self)]
+        else:
+            refs = [
+                ObjectRef(ObjectID.from_task(task_id, i), self.address)
+                for i in range(num_returns)
+            ]
         spec = TaskSpec(
             task_id=task_id,
             fn_id="",
@@ -1694,6 +1699,11 @@ class CoreWorker:
         self._release_arg_pins(spec.task_id.hex())
         if not isinstance(e, (TaskError, ActorDiedError, ActorUnavailableError)):
             e = TaskError(f"actor task {spec.name} failed: {e}", traceback.format_exc())
+        if spec.num_returns == -1:
+            # streaming: the error marker rides the done-slot, raised by
+            # the ObjectRefGenerator after the produced prefix is consumed
+            self.memory_store.put(self._stream_done_oid(spec.task_id), e)
+            return
         for i in range(spec.num_returns):
             self.memory_store.put(ObjectID.from_task(spec.task_id, i), e)
 

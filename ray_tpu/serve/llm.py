@@ -56,15 +56,22 @@ class LLMConfig:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "event", "result",
-                 "error")
+                 "error", "token_q")
 
-    def __init__(self, prompt, max_new, temperature):
+    def __init__(self, prompt, max_new, temperature, stream=False):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
         self.event = threading.Event()
         self.result: Optional[List[int]] = None
         self.error: Optional[BaseException] = None
+        # streaming consumers read tokens here as the engine produces
+        # them; None marks the end of the stream
+        self.token_q: Optional["queue.Queue"] = None
+        if stream:
+            import queue
+
+            self.token_q = queue.Queue()
 
 
 class _Slot:
@@ -115,24 +122,57 @@ class LLMServer:
 
     # -- request path ---------------------------------------------------
 
-    def __call__(self, request: Any) -> Dict[str, Any]:
+    def _parse(self, request: Any) -> "_Request":
         if hasattr(request, "json"):  # HTTP proxy path
-            request = request.json()
+            body = request.json()
+            stream = (
+                bool(body.get("stream"))
+                or request.query.get("stream") in ("1", "true")
+            )
+            request = body
+        else:
+            stream = bool(request.get("stream"))
         prompt = list(request.get("prompt_tokens") or [0])
         max_new = min(
             int(request.get("max_new_tokens", 16)),
             self.cfg.max_new_tokens_cap,
         )
         temperature = float(request.get("temperature", 0.0))
-        req = _Request(prompt, max_new, temperature)
+        return _Request(prompt, max_new, temperature, stream=stream)
+
+    def __call__(self, request: Any):
+        req = self._parse(request)
         with self._lock:
             self._queue.append(req)
         self._work.set()
+        if req.token_q is not None:
+            if self.cfg.engine != "kv":
+                raise ValueError("stream=True requires the kv engine")
+            return self._stream_tokens(req)
         if not req.event.wait(timeout=300):
             raise TimeoutError("generation timed out")
         if req.error is not None:
             raise req.error
         return {"tokens": req.result}
+
+    def _stream_tokens(self, req: "_Request"):
+        """Token-by-token generator (continuous batching pushes each
+        decoded token as its step completes; parity: vLLM's streaming
+        generate in the reference's serve.llm engine)."""
+        import queue as queue_mod
+
+        produced = 0
+        while True:
+            try:
+                tok = req.token_q.get(timeout=300)
+            except queue_mod.Empty:
+                raise TimeoutError("generation stalled") from None
+            if tok is None:
+                if req.error is not None:
+                    raise req.error
+                return
+            produced += 1
+            yield {"token": int(tok), "index": produced - 1}
 
     def batch_stats(self, _payload=None) -> Dict[str, Any]:
         with self._lock:
@@ -196,9 +236,13 @@ class LLMServer:
             except Exception as e:  # noqa: BLE001 — fail this request only
                 req.error = e
                 req.event.set()
+                if req.token_q is not None:
+                    req.token_q.put(None)
                 return
             first = int(self._sample_one(logits, req.temperature))
             slots[i] = _Slot(req, len(prompt), first)
+            if req.token_q is not None:
+                req.token_q.put(first)
             last[i] = first
             lengths[i] = len(prompt)
             temps[i] = max(req.temperature, 1e-6)
@@ -209,6 +253,8 @@ class LLMServer:
             slots[i] = None
             slot.req.result = slot.produced[: slot.req.max_new]
             slot.req.event.set()
+            if slot.req.token_q is not None:
+                slot.req.token_q.put(None)  # end of stream
 
         def fail_inflight(e: BaseException) -> None:
             # One poisoned round must not turn the replica into a black
@@ -218,6 +264,8 @@ class LLMServer:
                 if slots[i] is not None:
                     slots[i].req.error = e
                     slots[i].req.event.set()
+                    if slots[i].req.token_q is not None:
+                        slots[i].req.token_q.put(None)
                     slots[i] = None
 
         def one_round() -> None:
@@ -298,6 +346,12 @@ class LLMServer:
                     s.length += 1
                     s.last_token = int(toks[k, i])
                     s.produced.append(s.last_token)
+                    if (
+                        s.req.token_q is not None
+                        and len(s.produced) > 1  # first token sent at admit
+                        and len(s.produced) <= s.req.max_new
+                    ):
+                        s.req.token_q.put(s.last_token)
                     last[i] = s.last_token
                     lengths[i] = s.length
                     if (

@@ -36,11 +36,15 @@ class ServeProxy:
 
             def _handle(self, method: str):
                 parsed = urlparse(self.path)
+                query = dict(parse_qsl(parsed.query))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                if query.get("stream") in ("1", "true"):
+                    return self._handle_streaming(method, parsed.path,
+                                                  query, body)
                 try:
                     status, payload = proxy._dispatch(
-                        method, parsed.path, dict(parse_qsl(parsed.query)),
+                        method, parsed.path, query,
                         dict(self.headers), body,
                     )
                 except TimeoutError as e:
@@ -56,6 +60,44 @@ class ServeProxy:
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
                 self.wfile.write(payload)
+
+            def _handle_streaming(self, method, path, query, body):
+                """?stream=1: chunked transfer encoding, one JSON line per
+                streamed item (the reference proxy's streaming response
+                path over starlette; here raw HTTP/1.1 chunks)."""
+                deployment = proxy._router.deployment_for_route(path)
+                if deployment is None:
+                    payload = json.dumps({"error": f"no route for {path}"}).encode()
+                    self.send_response(404)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):x}\r\n".encode())
+                    self.wfile.write(data + b"\r\n")
+
+                try:
+                    request = Request(method, path, body, {}, query)
+                    for item in proxy._router.call_streaming(
+                        deployment, request, timeout_s=300
+                    ):
+                        line = (
+                            item if isinstance(item, bytes)
+                            else json.dumps(item).encode()
+                        )
+                        chunk(line + b"\n")
+                        self.wfile.flush()
+                except Exception as e:  # noqa: BLE001 — trailer chunk
+                    chunk(json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    ).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
 
             def do_GET(self):
                 self._handle("GET")

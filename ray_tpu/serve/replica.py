@@ -71,6 +71,31 @@ class ServeReplica:
             with self._lock:
                 self._ongoing -= 1
 
+    @ray_tpu.method(num_returns="streaming")
+    def handle_request_streaming(self, payload: Any, *,
+                                 method: Optional[str] = None):
+        """Streaming variant: the deployment returns an iterable and each
+        item reaches the caller as it is produced (core streaming
+        generators; parity: reference streaming deployment responses
+        through the proxy's chunked transfer)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = self._callable
+            if method:
+                target = getattr(self._callable, method)
+            result = target(payload)
+            if result is None:
+                return
+            if isinstance(result, (bytes, str, dict)):
+                yield result  # non-iterable response: one chunk
+                return
+            yield from result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def health(self) -> bool:
         return True
 

@@ -31,6 +31,34 @@ class Router:
         # replica_id -> locally-issued in-flight count (delta on top of
         # the controller-reported ongoing count)
         self._local_inflight: Dict[str, int] = {}
+        self._stopped = threading.Event()
+        # TOPOLOGY long-poll: replica add/remove/death propagates in ~ms
+        # (the controller holds the reply until its version changes)
+        # instead of the 1 s ongoing-count refresh cadence — the round-3
+        # "router thrashes between refreshes" weakness.
+        threading.Thread(
+            target=self._topology_longpoll, name="router-longpoll",
+            daemon=True,
+        ).start()
+
+    def _topology_longpoll(self) -> None:
+        while not self._stopped.is_set():
+            with self._lock:
+                version = self._version
+            try:
+                reply = ray_tpu.get(
+                    self._controller.get_routing_table.remote(version, 20.0),
+                    timeout=40,
+                )
+            except Exception:  # noqa: BLE001 — controller briefly away
+                self._stopped.wait(1.0)
+                continue
+            if reply.get("table") is not None:
+                with self._lock:
+                    if reply["version"] != self._version:
+                        self._version = reply["version"]
+                        self._table = reply["table"]
+                        self._last_refresh = time.monotonic()
 
     def _refresh(self, force: bool = False) -> None:
         now = time.monotonic()
@@ -111,6 +139,23 @@ class Router:
         if method:
             return rid, handle.handle_request.remote(payload, method=method)
         return rid, handle.handle_request.remote(payload)
+
+    def call_streaming(self, deployment: str, payload: Any,
+                       method: Optional[str] = None,
+                       timeout_s: float = 60.0):
+        """Route one request to the replica's streaming entry point and
+        yield items as they are produced (core actor streaming
+        generators). The in-flight delta is held until the stream is
+        exhausted or abandoned."""
+        rid, handle = self.choose_replica(deployment, timeout_s)
+        try:
+            gen = handle.handle_request_streaming.remote(
+                payload, method=method
+            )
+            for item_ref in gen:
+                yield ray_tpu.get(item_ref, timeout=timeout_s)
+        finally:
+            self.request_finished(rid)
 
     def call(self, deployment: str, payload: Any,
              method: Optional[str] = None, timeout_s: float = 60.0) -> Any:

@@ -202,3 +202,36 @@ def test_async_actor_errors_propagate(rt):
 
     with _pytest.raises(Exception, match="async kaboom"):
         ray_tpu.get(b.boom.remote(), timeout=60)
+
+
+def test_streaming_actor_method(rt):
+    """num_returns="streaming" on actor methods: items arrive through an
+    ObjectRefGenerator as the generator yields (parity: reference
+    streaming generators on actors)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    class Gen:
+        @ray_tpu.method(num_returns="streaming")
+        def count(self, n):
+            for i in range(n):
+                yield i * 10
+
+        @ray_tpu.method(num_returns="streaming")
+        def flaky(self):
+            yield 1
+            raise ValueError("stream kaboom")
+
+    g = Gen.remote()
+    vals = [ray_tpu.get(r, timeout=60) for r in g.count.remote(5)]
+    assert vals == [0, 10, 20, 30, 40]
+    # plain methods on the same actor still work
+    gen2 = g.count.options(num_returns="streaming").remote(2)
+    assert [ray_tpu.get(r, timeout=60) for r in gen2] == [0, 10]
+    # errors raise after the produced prefix
+    import pytest as _pytest
+
+    gen3 = g.flaky.remote()
+    assert ray_tpu.get(next(gen3), timeout=60) == 1
+    with _pytest.raises(Exception, match="stream kaboom"):
+        ray_tpu.get(next(gen3), timeout=60)
