@@ -365,6 +365,19 @@ class ControlStore:
             self._view_version += 1
         return {"ok": True}
 
+    def rpc_capacity_freed(self, conn, node_id: str):
+        """A lease was released on `node_id`: retry parked scheduling work
+        immediately instead of waiting out its backoff (ADVICE r4: pending
+        actors otherwise idle up to 2s after capacity frees). Coalesced:
+        on a busy cluster every release fires this, so kicks within 100ms
+        collapse to one — a dropped kick only costs one short backoff step
+        (heartbeat anti-entropy is the backstop)."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_kick_req", 0.0) >= 0.1:
+            self._last_kick_req = now
+            self._sched_enqueue(("kick",))
+        return {"ok": True}
+
     def rpc_get_nodes(self, conn, alive_only: bool = True):
         with self._lock:
             return [
@@ -548,10 +561,24 @@ class ControlStore:
             )
 
     def _sched_kick(self) -> None:
-        """Cluster capacity changed (node joined): retry everything now."""
+        """Cluster capacity changed (node joined / lease freed / worker
+        spawned): retry everything now, and reset the kicked keys' backoff
+        so a retry that races the freed capacity (e.g. replacement worker
+        still booting) re-polls at 50ms instead of the 2s cap."""
         with self._sched_retry_lock:
             items = [it for _, _, it in self._sched_retries]
             self._sched_retries.clear()
+            for it in items:
+                # HALVE (not clear) the backoff: the kick itself is the
+                # immediate retry, and a later capacity event kicks again
+                # — but a permanently-unplaceable item on a high-churn
+                # cluster must keep re-climbing toward the cap instead of
+                # running a full placement pass per kick at the 50ms floor
+                key = tuple(it[:2])
+                if key in self._sched_backoff:
+                    self._sched_backoff[key] = max(
+                        0.05, self._sched_backoff[key] / 2
+                    )
         for it in items:
             self._sched_q.put(it)
 

@@ -461,6 +461,7 @@ class NodeAgent:
             with self._lock:
                 self._pending_spawns = max(0, self._pending_spawns - 1)
                 self._cv.notify_all()
+        freed_lease = False
         with self._lock:
             for w in self._workers.values():
                 if w.proc is proc:
@@ -471,9 +472,14 @@ class NodeAgent:
                 if dead.state == "leased" and dead.lease_id in self._leases:
                     info = self._leases.pop(dead.lease_id)
                     self._release_resources_locked(info)
+                    freed_lease = True
                 dead.state = "dead"
                 self._cv.notify_all()
         if dead is not None and not self._stopped.is_set():
+            if freed_lease:
+                # only a leased worker's death frees capacity; an idle
+                # worker crash-looping must not spam cluster-wide kicks
+                self._notify_capacity_freed()
             try:
                 self._control.call_oneway(
                     "report_worker_failure",
@@ -492,6 +498,9 @@ class NodeAgent:
                         kind=kind)
             self._workers[worker_id] = w
             self._cv.notify_all()
+        # a fresh idle worker unparks zero-wait lease retries just like
+        # freed resources do
+        self._notify_capacity_freed()
         return {"node_id": self.node_id.hex(), "session_id": self.session_id}
 
     def _terminate_worker(self, w: _Worker) -> None:
@@ -691,10 +700,26 @@ class NodeAgent:
             self._cv.notify_all()
         if kill and worker is not None:
             self._terminate_worker(worker)
+        self._notify_capacity_freed()
         return True
 
     def _release_resources_locked(self, info: Dict[str, Any]) -> None:
         self._deallocate_locked(info["resources"], info["bundle"])
+
+    def _notify_capacity_freed(self) -> None:
+        """Tell the store capacity freed here so pending actors/PGs retry
+        NOW instead of waiting out their (up to 2s) scheduler backoff.
+        Debounced: a burst of releases sends one kick per 50ms."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_free_notify", 0.0) < 0.05:
+            return
+        self._last_free_notify = now
+        try:
+            self._control.call_oneway(
+                "capacity_freed", node_id=self.node_id.hex()
+            )
+        except RpcError:
+            pass  # heartbeat anti-entropy covers the lost kick
 
     def _try_allocate_locked(self, resources, bundle):
         """Returns (ok, resolved_bundle). resolved_bundle pins the concrete

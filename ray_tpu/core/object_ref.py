@@ -174,7 +174,9 @@ class ObjectRefGenerator:
                 # in-order item pushes and can overtake them: give items
                 # yielded before the failure a short grace to land
                 if err_deadline is None:
-                    err_deadline = _time.monotonic() + 0.25
+                    err_deadline = (
+                        _time.monotonic() + config.stream_error_grace_s
+                    )
                 elif _time.monotonic() > err_deadline:
                     raise marker
             if has_marker and not is_err:

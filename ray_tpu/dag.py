@@ -254,6 +254,11 @@ class CompiledDAG:
         with self._lock:
             if self._torn_down:
                 raise RuntimeError("compiled DAG was torn down")
+            if self._broken:
+                raise RuntimeError(
+                    "compiled DAG stream desynced (an earlier round failed "
+                    "mid-write); teardown and recompile"
+                )
             if self._exec_seq - self._read_seq >= self._max_inflight:
                 raise RuntimeError(
                     f"compiled DAG has {self._exec_seq - self._read_seq} "
@@ -262,8 +267,17 @@ class CompiledDAG:
                 )
             if self._input is not None:
                 payload = serialization.pack(args[0] if len(args) == 1 else args)
-                for ch in self._input_channels:
-                    ch.write(payload)
+                for i, ch in enumerate(self._input_channels):
+                    try:
+                        ch.write(payload)
+                    except Exception:
+                        if i > 0:
+                            # earlier channels already hold this round's
+                            # payload: actors would pair inputs across
+                            # rounds — poison the DAG so later calls fail
+                            # loudly instead of silently desyncing
+                            self._broken = True
+                        raise
             self._exec_seq += 1
             return CompiledDAGRef(self, self._exec_seq)
 

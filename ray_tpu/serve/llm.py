@@ -142,12 +142,14 @@ class LLMServer:
 
     def __call__(self, request: Any):
         req = self._parse(request)
+        if req.token_q is not None and self.cfg.engine != "kv":
+            # validate BEFORE enqueue: the engine would otherwise decode a
+            # request whose caller already got the ValueError
+            raise ValueError("stream=True requires the kv engine")
         with self._lock:
             self._queue.append(req)
         self._work.set()
         if req.token_q is not None:
-            if self.cfg.engine != "kv":
-                raise ValueError("stream=True requires the kv engine")
             return self._stream_tokens(req)
         if not req.event.wait(timeout=300):
             raise TimeoutError("generation timed out")
@@ -233,12 +235,17 @@ class LLMServer:
                     mcfg, self.params, jnp.asarray(tok),
                     jnp.int32(len(prompt)), cache_k, cache_v, jnp.int32(i),
                 )
-            except Exception as e:  # noqa: BLE001 — fail this request only
+            except Exception as e:  # noqa: BLE001
                 req.error = e
                 req.event.set()
                 if req.token_q is not None:
                     req.token_q.put(None)
-                return
+                # prefill donates the caches too: a post-dispatch failure
+                # here deleted them, so every slot's state is garbage —
+                # propagate so the outer handler fails in-flight requests
+                # and marks the caches for rebuild (this request's error
+                # is already set; fail_inflight won't see it in slots)
+                raise
             first = int(self._sample_one(logits, req.temperature))
             slots[i] = _Slot(req, len(prompt), first)
             if req.token_q is not None:
@@ -272,6 +279,8 @@ class LLMServer:
             """One continuous-batching round: admit → decode chunk →
             bookkeeping."""
             nonlocal cache_k, cache_v, dev_state, step_no
+            if cache_k is None:  # rebuild after a poisoned (donated) round
+                cache_k, cache_v = dec.init_cache(mcfg, S, T_max)
             # admit new requests into free slots (continuous batching)
             admitted = False
             for i in range(S):
@@ -377,6 +386,14 @@ class LLMServer:
                 )
                 fail_inflight(e)
                 dev_state = None
+                # prefill/decode donate the caches (donate_argnums): an
+                # exception raised after dispatch leaves cache_k/cache_v
+                # pointing at deleted buffers on TPU, so every later round
+                # would fail too — mark them for rebuild (done inside the
+                # next round's try so a failing rebuild — same OOM/device
+                # error — can't kill the engine thread)
+                cache_k = cache_v = None
+                time.sleep(0.05)  # don't hot-spin on a persistent fault
 
     def _sample_one(self, logits, temperature: float) -> int:
         import jax

@@ -118,6 +118,10 @@ config.define("borrow_pin_ttl_s", 600.0)
 # Streaming generators: once the done-marker says item i exists, how long
 # to wait for its (in-flight) push before declaring the item lost.
 config.define("stream_item_grace_s", 30.0)
+# After a stream's error marker lands, how long to keep delivering the
+# validly-produced prefix (whose pushes ride a different connection and can
+# trail the error reply) before raising the error.
+config.define("stream_error_grace_s", 2.0)
 # Owner-side lineage entries kept for object reconstruction (reference
 # bounds lineage by bytes; we bound by task count).
 config.define("lineage_max_entries", 10000)
