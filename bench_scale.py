@@ -1,0 +1,171 @@
+"""Scale-envelope benchmarks (miniature of the reference's release
+benchmarks, /root/reference/release/benchmarks/README.md:11-20: many
+tasks / many actors / many PGs / object broadcast).
+
+Each section prints one JSON line and the whole run writes
+BENCH_SCALE.json. Sized for this harness (one physical core): the point
+is that the control plane — owner queues, scheduler, lease protocol,
+data plane — survives the SHAPE of the reference envelope (tens of
+thousands of queued tasks, thousands of registered actors, hundreds of
+concurrent PGs, a multi-node broadcast) without storms or thread
+explosions, not that one core matches a 256-core cluster's absolute
+numbers.
+
+Run: python bench_scale.py
+"""
+
+import json
+import time
+
+RESULTS = {}
+
+
+def record(name, value, unit, **detail):
+    RESULTS[name] = {"value": round(value, 1), "unit": unit, **detail}
+    print(json.dumps({"metric": name, "value": round(value, 1),
+                      "unit": unit, **detail}), flush=True)
+
+
+def bench_many_tasks(n=100_000):
+    """100k tasks queued on one node (reference: 1M queued / 10k-running
+    envelope, release/benchmarks/README.md). Measures owner-side submit
+    rate (tasks enter the lease-cache queue) and end-to-end drain."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    # warm the lease pool + fn profile
+    ray_tpu.get([nop.remote() for _ in range(100)])
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    submit_dt = time.perf_counter() - t0
+    record("tasks_100k_submit", n / submit_dt, "tasks/s",
+           queued=n)
+    t0 = time.perf_counter()
+    ray_tpu.get(refs)
+    drain_dt = time.perf_counter() - t0
+    record("tasks_100k_drain", n / drain_dt, "tasks/s",
+           wall_s=round(submit_dt + drain_dt, 1))
+
+
+def bench_many_actors(n_registered=2000, n_alive=48):
+    """2000 actors registered against bounded capacity (reference:
+    many_actors envelope). Most stay PENDING in the store's scheduler
+    queue — the test is that registration stays fast, the retry heap
+    doesn't melt, and alive actors still answer pings underneath the
+    pending pile; then a full kill drain."""
+    import ray_tpu
+    from ray_tpu.core.worker import global_worker
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n_registered)]
+    reg_dt = time.perf_counter() - t0
+    record("actors_2000_register", n_registered / reg_dt, "actors/s")
+
+    # the first `capacity` actors go alive; they must answer pings while
+    # ~2k pending actors sit in the scheduler
+    t0 = time.perf_counter()
+    alive = ray_tpu.get(
+        [a.ping.remote() for a in actors[:n_alive]], timeout=600
+    )
+    assert sum(alive) == n_alive
+    record("actors_alive_under_load_ping_s", time.perf_counter() - t0, "s",
+           alive=n_alive, pending=n_registered - n_alive)
+
+    t0 = time.perf_counter()
+    for a in actors:
+        ray_tpu.kill(a)
+    # drain: the store must settle (no pending actors left)
+    w = global_worker()
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        listing = w.control.call("list_actors")
+        states = [a["state"] for a in listing]
+        if all(s == "DEAD" for s in states):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"actors did not drain: {set(states)}")
+    record("actors_2000_kill_drain_s", time.perf_counter() - t0, "s")
+
+
+def bench_many_pgs(n=200):
+    """200 concurrent placement groups, all READY at once, then removed
+    (reference: many_pgs envelope)."""
+    import ray_tpu
+
+    t0 = time.perf_counter()
+    pgs = [
+        ray_tpu.placement_group(
+            [{"CPU": 0.01}, {"CPU": 0.01}], strategy="PACK"
+        )
+        for _ in range(n)
+    ]
+    for pg in pgs:
+        assert pg.wait(timeout_seconds=300)
+    ready_dt = time.perf_counter() - t0
+    record("pgs_200_ready", n / ready_dt, "pgs/s", wall_s=round(ready_dt, 1))
+    t0 = time.perf_counter()
+    for pg in pgs:
+        ray_tpu.remove_placement_group(pg)
+    record("pgs_200_remove_s", time.perf_counter() - t0, "s")
+
+
+def bench_broadcast(mb=256, n_nodes=8):
+    """One 256 MiB object broadcast to 8 virtual nodes over the raw-TCP
+    sendfile data plane (reference: object broadcast envelope)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster()
+    for _ in range(n_nodes):
+        cluster.add_node(num_cpus=1)
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote(num_cpus=1)
+        def touch(arr):
+            return int(arr[0] + arr[-1])
+
+        payload = np.ones(mb * 1024 * 1024 // 8, np.float64)
+        ref = ray_tpu.put(payload)
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(
+            [touch.remote(ref) for _ in range(n_nodes)], timeout=600
+        )
+        dt = time.perf_counter() - t0
+        assert outs == [2] * n_nodes
+        record("broadcast_256mb_8nodes", mb * n_nodes / dt, "MiB/s",
+               wall_s=round(dt, 1))
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            cluster.shutdown()
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=48)
+    bench_many_tasks()
+    bench_many_actors()
+    bench_many_pgs()
+    ray_tpu.shutdown()
+    bench_broadcast()
+    with open("BENCH_SCALE.json", "w") as f:
+        json.dump(RESULTS, f, indent=2)
+    print(json.dumps({"ok": True, "file": "BENCH_SCALE.json"}))
+
+
+if __name__ == "__main__":
+    main()

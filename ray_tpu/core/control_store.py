@@ -669,6 +669,9 @@ class ControlStore:
                 resources=resources,
                 bundle=scheduling.pg_bundle_of(strategy),
                 wait_s=0.0,
+                # actor leases are store-managed: a transient store->agent
+                # reconnect must not reap every actor on the node
+                bind_to_conn=False,
             )
         except RpcError as e:
             logger.warning(

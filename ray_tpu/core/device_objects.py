@@ -181,6 +181,12 @@ class DeviceObjectStore:
             arrays, _, _ = self._objects[obj_hex]
         return [np.asarray(a).tobytes() for a in arrays]
 
+    def arrays(self, obj_hex: str) -> List[Any]:
+        """The live device arrays (for the shm/data-plane export path)."""
+        with self._lock:
+            arrays, _, _ = self._objects[obj_hex]
+        return arrays
+
     def free(self, obj_hex: str) -> None:
         with self._lock:
             self._objects.pop(obj_hex, None)

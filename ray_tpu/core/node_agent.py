@@ -73,6 +73,7 @@ class NodeAgent:
         self.control_address = control_address
         self._server = RpcServer("node_agent", host, port)
         self._server.register_instance(self)
+        self._server.on_disconnect = self._owner_conn_closed
 
         from ray_tpu.accelerators import detect_node_resources_and_labels
 
@@ -520,7 +521,15 @@ class NodeAgent:
         bundle=None,
         strategy=None,
         wait_s: float = 30.0,
+        bind_to_conn: bool = True,
     ):
+        """bind_to_conn: a lease granted to a driver/executor (the lease
+        cache) dies with its owner's RPC connection — an owner that exits
+        without releasing (crash, no shutdown()) must not strand leased
+        workers forever. The control store passes False: actor leases are
+        store-managed (actor death/restart flows release them), and a
+        transient store->agent reconnect must NOT kill every actor on the
+        node."""
         resources = {k: float(v) for k, v in (resources or {}).items() if v}
         # Cluster-level decision: can/should this run here? (spillback)
         if bundle is None:
@@ -561,9 +570,13 @@ class NodeAgent:
                     return {"granted": False, "error": "bundle not found"}
         deadline = time.monotonic() + wait_s
         kind = "tpu" if resources.get("TPU") else "cpu"
-        return self._lease_wait(resources, bundle, deadline, kind, strategy)
+        owner_conn_id = id(conn) if (bind_to_conn and conn is not None) else None
+        return self._lease_wait(
+            resources, bundle, deadline, kind, strategy, owner_conn_id
+        )
 
-    def _lease_wait(self, resources, bundle, deadline, kind, strategy=None):
+    def _lease_wait(self, resources, bundle, deadline, kind, strategy=None,
+                    owner_conn_id=None):
         spawned_for_me = False
         starved = False  # counted toward autoscaler demand
         last_spill_check = time.monotonic()
@@ -595,6 +608,7 @@ class NodeAgent:
                             "resources": resources,
                             "bundle": resolved_bundle,
                             "worker_id": worker.worker_id,
+                            "conn_id": owner_conn_id,
                         }
                         return {
                             "granted": True,
@@ -683,6 +697,23 @@ class NodeAgent:
         if not all(avail.get(k, 0.0) >= v for k, v in resources.items() if v > 0):
             return None
         return {"node_id": node_id, "address": view[node_id]["address"]}
+
+    def _owner_conn_closed(self, conn) -> None:
+        """An RPC client disconnected: reap every conn-bound lease it
+        held (reference: raylet disconnects kill the worker leases of a
+        dead owner). kill=True — the worker may be mid-task for the dead
+        owner; a poisoned warm worker is worse than a respawn."""
+        conn_id = id(conn)
+        with self._lock:
+            dead = [
+                lid for lid, info in self._leases.items()
+                if info.get("conn_id") == conn_id
+            ]
+        for lid in dead:
+            try:
+                self.rpc_release_worker(None, lid, kill=True)
+            except Exception:  # noqa: BLE001 — teardown path
+                logger.exception("lease %s reap failed", lid[:8])
 
     def rpc_release_worker(self, conn, lease_id: str, kill: bool = False):
         with self._lock:

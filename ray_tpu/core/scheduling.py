@@ -106,6 +106,22 @@ def pick_node(
     return _hybrid(view, resources, local_node_id)
 
 
+# Above this cluster size, placement scores a random sample of nodes
+# instead of the whole view (reference hybrid_scheduling_policy.h:51
+# bounded top-k sampling): per-decision cost stays O(k) however many
+# thousand nodes are registered, at the price of a near-optimal (not
+# optimal) pick — with a full-scan fallback when the sample has no fit,
+# so a nearly-full cluster still finds its last free node.
+TOPK_SAMPLE = 32
+
+
+def _sample_view(view: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    if len(view) <= TOPK_SAMPLE:
+        return view
+    keys = random.sample(list(view), TOPK_SAMPLE)
+    return {k: view[k] for k in keys}
+
+
 def _hybrid(
     view: Dict[str, Dict[str, Any]],
     resources: Dict[str, float],
@@ -120,28 +136,43 @@ def _hybrid(
             and _utilization(node) < SPREAD_THRESHOLD
         ):
             return local_node_id
-    fitting = [
-        (nid, n) for nid, n in view.items()
-        if _fits(resources, n["resources_available"])
-    ]
-    if fitting:
-        # lowest utilization wins; tie-break randomly to avoid herding
-        random.shuffle(fitting)
-        fitting.sort(key=lambda kv: _utilization(kv[1]))
-        return fitting[0][0]
-    feasible = [
-        nid for nid, n in view.items() if _feasible(resources, n["resources_total"])
-    ]
-    if feasible:
-        return random.choice(feasible)
-    return None
+    sampled = _sample_view(view)
+    while True:
+        fitting = [
+            (nid, n) for nid, n in sampled.items()
+            if _fits(resources, n["resources_available"])
+        ]
+        if fitting:
+            # lowest utilization wins; tie-break randomly to avoid herding
+            random.shuffle(fitting)
+            fitting.sort(key=lambda kv: _utilization(kv[1]))
+            return fitting[0][0]
+        if sampled is not view:
+            # sample had nothing with free capacity: full scan before
+            # settling for a feasible-but-full node — on a busy cluster
+            # the one free node is rarely in a 32-node sample
+            sampled = view
+            continue
+        feasible = [
+            nid for nid, n in sampled.items()
+            if _feasible(resources, n["resources_total"])
+        ]
+        if feasible:
+            return random.choice(feasible)
+        return None
 
 
 def _spread(view, resources) -> Optional[str]:
+    sampled = _sample_view(view)
     fitting = [
-        (nid, n) for nid, n in view.items()
+        (nid, n) for nid, n in sampled.items()
         if _fits(resources, n["resources_available"])
     ]
+    if not fitting and sampled is not view:
+        fitting = [
+            (nid, n) for nid, n in view.items()
+            if _fits(resources, n["resources_available"])
+        ]
     if not fitting:
         return _hybrid(view, resources)
     random.shuffle(fitting)

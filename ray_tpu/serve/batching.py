@@ -1,0 +1,156 @@
+"""@serve.batch — transparent request batching inside a deployment.
+
+Parity: the reference's ``ray.serve.batch`` (python/ray/serve/batching.py:1
+_BatchQueue + @serve.batch decorator): concurrent calls to the decorated
+method are gathered into one list-in/list-out invocation, amortizing
+per-call overhead (tokenization, device dispatch) across the batch.
+
+Thread-based (not asyncio): replicas execute requests on actor
+max_concurrency threads, so the accumulator collects across those threads
+— the first caller of an empty batch becomes the *flusher* and waits out
+``batch_wait_timeout_s`` (or until ``max_batch_size`` arrives), everyone
+else parks on their item's event. Matches the reference's semantics:
+
+- the wrapped function receives a LIST of requests and must return a
+  list of equal length (ValueError otherwise, delivered to every caller);
+- per-item exceptions: if the batch fn raises, every batched caller gets
+  the error;
+- ``max_batch_size`` / ``batch_wait_timeout_s`` are tunable at decoration
+  time and via ``set_max_batch_size`` / ``set_batch_wait_timeout_s``
+  handles (reference batching.py set_* parity).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+class _Item:
+    __slots__ = ("value", "event", "result", "error")
+
+    def __init__(self, value):
+        self.value = value
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._items: List[_Item] = []
+        self._flusher_active = False
+        self._arrived = threading.Condition(self._lock)
+        # observability (reference exposes batch utilization metrics)
+        self.num_batches = 0
+        self.batch_sizes: List[int] = []
+
+    def call(self, instance, value) -> Any:
+        item = _Item(value)
+        with self._lock:
+            self._items.append(item)
+            self._arrived.notify_all()
+            if not self._flusher_active:
+                self._flusher_active = True
+                flusher = True
+            else:
+                flusher = False
+        if flusher:
+            self._flush_when_ready(instance)
+        if not item.event.wait(timeout=300.0):
+            raise TimeoutError("batched call timed out")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _flush_when_ready(self, instance) -> None:
+        deadline = time.monotonic() + self.batch_wait_timeout_s
+        with self._lock:
+            while (
+                len(self._items) < self.max_batch_size
+                and time.monotonic() < deadline
+            ):
+                self._arrived.wait(
+                    max(0.0, min(deadline - time.monotonic(), 0.05))
+                )
+            batch, self._items = (
+                self._items[: self.max_batch_size],
+                self._items[self.max_batch_size:],
+            )
+            self._flusher_active = False
+            if self._items:
+                # leftovers: promote a new flusher via the next call —
+                # wake a parked caller so ITS thread takes over
+                self._flusher_active = True
+                threading.Thread(
+                    target=self._flush_when_ready, args=(instance,),
+                    daemon=True,
+                ).start()
+        if not batch:
+            return
+        self.num_batches += 1
+        self.batch_sizes.append(len(batch))
+        if len(self.batch_sizes) > 100:
+            del self.batch_sizes[:-100]
+        try:
+            args = [it.value for it in batch]
+            results = (
+                self.fn(instance, args) if instance is not None
+                else self.fn(args)
+            )
+            if not isinstance(results, (list, tuple)) or len(results) != len(batch):
+                raise ValueError(
+                    f"@serve.batch function {self.fn.__name__} must return "
+                    f"a list of length {len(batch)}, got {type(results)}"
+                )
+            for it, r in zip(batch, results):
+                it.result = r
+                it.event.set()
+        except BaseException as e:  # noqa: BLE001 — fan the error out
+            for it in batch:
+                it.error = e
+                it.event.set()
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: gather concurrent calls into one list-in/list-out call.
+
+    Usage (reference @serve.batch parity)::
+
+        @serve.deployment(max_concurrency=16)
+        class Model:
+            @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.02)
+            def __call__(self, requests):   # receives a LIST
+                return [self.net(r) for r in requests]
+    """
+
+    def wrap(fn):
+        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        def inner(self_or_first, *rest):
+            # method: inner(self, request); free function: inner(request)
+            if rest:
+                return queue.call(self_or_first, rest[0])
+            return queue.call(None, self_or_first)
+
+        inner._rt_batch_queue = queue
+        inner.set_max_batch_size = (
+            lambda n: setattr(queue, "max_batch_size", int(n))
+        )
+        inner.set_batch_wait_timeout_s = (
+            lambda s: setattr(queue, "batch_wait_timeout_s", float(s))
+        )
+        return inner
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,211 @@
+"""Minimal asyncio HTTP/1.1 server for the serve data plane.
+
+Parity rationale: the reference proxy is ASGI/asyncio (uvicorn +
+starlette, python/ray/serve/_private/proxy.py:732) — connection handling
+is event-driven, so thousands of keep-alive connections cost one loop,
+not one thread each. This is the same design without external deps: a
+hand-rolled HTTP/1.1 parser over ``asyncio.start_server``, keep-alive by
+default, chunked transfer for streaming handlers, and a bounded thread
+pool for the (blocking) replica calls.
+
+Handlers are plain callables (run in the pool, NOT on the loop):
+
+    handler(method, path, query, headers, body)
+      -> (status:int, content_type:str, payload:bytes)        # unary
+      -> generator yielding bytes                             # streaming
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qsl, unquote, urlparse
+
+_MAX_HEADER = 64 * 1024
+_MAX_BODY = 256 * 1024 * 1024
+
+
+class AioHttpServer:
+    def __init__(self, handler: Callable, port: int = 0,
+                 host: str = "0.0.0.0", pool_size: int = 32):
+        self._handler = handler
+        self._host = host
+        self._port = port
+        self._pool = ThreadPoolExecutor(
+            max_workers=pool_size, thread_name_prefix="serve-call"
+        )
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="serve-aio", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30):
+            raise RuntimeError("asyncio HTTP server failed to start")
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def boot():
+            server = await asyncio.start_server(
+                self._serve_conn, self._host, self._port,
+            )
+            self._port = server.sockets[0].getsockname()[1]
+            self._started.set()
+            async with server:
+                await server.serve_forever()
+
+        try:
+            loop.run_until_complete(boot())
+        except asyncio.CancelledError:
+            pass
+        finally:
+            loop.close()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(
+                lambda: [t.cancel() for t in asyncio.all_tasks(self._loop)]
+            )
+        self._pool.shutdown(wait=False)
+
+    # -- connection handling -------------------------------------------
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    head = await reader.readuntil(b"\r\n\r\n")
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                except asyncio.LimitOverrunError:
+                    await self._simple(writer, 431, b'{"error":"headers too large"}')
+                    return
+                if len(head) > _MAX_HEADER:
+                    await self._simple(writer, 431, b'{"error":"headers too large"}')
+                    return
+                try:
+                    method, target, headers = self._parse_head(head)
+                except ValueError:
+                    await self._simple(writer, 400, b'{"error":"bad request"}')
+                    return
+                length = int(headers.get("content-length") or 0)
+                if length > _MAX_BODY:
+                    await self._simple(writer, 413, b'{"error":"body too large"}')
+                    return
+                body = await reader.readexactly(length) if length else b""
+                parsed = urlparse(target)
+                path = unquote(parsed.path)
+                query = dict(parse_qsl(parsed.query))
+                keep = headers.get("connection", "keep-alive").lower() != "close"
+                loop = asyncio.get_running_loop()
+                try:
+                    result = await loop.run_in_executor(
+                        self._pool, self._handler, method, path, query,
+                        headers, body,
+                    )
+                except Exception as e:  # noqa: BLE001 — handler crash -> 500
+                    await self._simple(
+                        writer, 500,
+                        f'{{"error":"{type(e).__name__}"}}'.encode(), keep,
+                    )
+                    if not keep:
+                        return
+                    continue
+                if hasattr(result, "__next__"):  # streaming generator
+                    await self._stream(writer, result, loop)
+                    # chunked responses end the exchange cleanly; keep
+                    # the connection for the next request
+                else:
+                    status, ctype, payload = result
+                    await self._respond(writer, status, ctype, payload, keep)
+                if not keep:
+                    return
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @staticmethod
+    def _parse_head(head: bytes) -> Tuple[str, str, Dict[str, str]]:
+        lines = head.decode("latin-1").split("\r\n")
+        parts = lines[0].split(" ")
+        if len(parts) != 3:
+            raise ValueError("bad request line")
+        method, target, _version = parts
+        headers: Dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        return method.upper(), target, headers
+
+    async def _respond(self, writer, status: int, ctype: str,
+                       payload: bytes, keep: bool) -> None:
+        writer.write(
+            b"HTTP/1.1 %d %s\r\n"
+            b"Content-Type: %s\r\n"
+            b"Content-Length: %d\r\n"
+            b"Connection: %s\r\n\r\n"
+            % (
+                status, _REASONS.get(status, b"OK"), ctype.encode(),
+                len(payload), b"keep-alive" if keep else b"close",
+            )
+        )
+        writer.write(payload)
+        await writer.drain()
+
+    async def _simple(self, writer, status: int, payload: bytes,
+                      keep: bool = False) -> None:
+        await self._respond(
+            writer, status, "application/json", payload, keep
+        )
+
+    async def _stream(self, writer, gen, loop) -> None:
+        """Chunked transfer encoding: one chunk per yielded bytes item.
+        The (blocking) generator advances on the pool, the writes on the
+        loop."""
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/x-ndjson\r\n"
+            b"Transfer-Encoding: chunked\r\n"
+            b"Connection: keep-alive\r\n\r\n"
+        )
+        try:
+            while True:
+                item = await loop.run_in_executor(self._pool, _next_or_done, gen)
+                if item is _DONE:
+                    break
+                writer.write(b"%x\r\n%s\r\n" % (len(item), item))
+                await writer.drain()
+        finally:
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+
+
+_DONE = object()
+
+
+def _next_or_done(gen):
+    try:
+        return next(gen)
+    except StopIteration:
+        return _DONE
+
+
+_REASONS = {
+    200: b"OK", 400: b"Bad Request", 404: b"Not Found",
+    413: b"Payload Too Large", 431: b"Request Header Fields Too Large",
+    500: b"Internal Server Error", 503: b"Service Unavailable",
+}

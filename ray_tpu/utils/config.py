@@ -122,6 +122,18 @@ config.define("stream_item_grace_s", 30.0)
 # validly-produced prefix (whose pushes ride a different connection and can
 # trail the error reply) before raising the error.
 config.define("stream_error_grace_s", 2.0)
+# Normal-task lease cache (reference normal_task_submitter.h:52-82):
+# how long a granted worker lease is kept warm after its queue drains
+# before being returned to the node agent, and how many lease requests
+# one scheduling key keeps in flight (owner-side rate limiting; reference
+# max_pending_lease_requests).
+config.define("lease_keepalive_s", 1.0)
+config.define("max_lease_requests_per_key", 10)
+# Lease pool sizing (Little's law): hold enough workers to drain the
+# queue in about this long given the measured per-task service latency.
+# Short tasks pipeline onto few warm workers (a worker process per nop
+# task is pure context-switch overhead); long tasks scale wide.
+config.define("lease_rampup_target_s", 0.1)
 # Owner-side lineage entries kept for object reconstruction (reference
 # bounds lineage by bytes; we bound by task count).
 config.define("lineage_max_entries", 10000)

@@ -1,0 +1,228 @@
+"""@serve.batch + @serve.multiplexed + asyncio proxy tests (parity
+models: reference python/ray/serve/tests/test_batching.py and
+test_multiplex.py)."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import multiplexed
+
+
+def _fan(fn, values, timeout=30.0):
+    """Call fn(v) from one thread per value; return results in order."""
+    results = [None] * len(values)
+    errors = []
+
+    def run(i, v):
+        try:
+            results[i] = fn(v)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=run, args=(i, v))
+        for i, v in enumerate(values)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    return results, errors
+
+
+def test_batch_coalesces_concurrent_calls():
+    seen_batches = []
+
+    @batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+    def double(xs):
+        seen_batches.append(len(xs))
+        return [x * 2 for x in xs]
+
+    results, errors = _fan(double, list(range(8)))
+    assert not errors
+    assert results == [x * 2 for x in range(8)]
+    # all 8 concurrent calls should ride few (ideally 1) batches
+    assert max(seen_batches) >= 4
+
+
+def test_batch_single_call_flushes_on_timeout():
+    @batch(max_batch_size=64, batch_wait_timeout_s=0.02)
+    def echo(xs):
+        return list(xs)
+
+    t0 = time.monotonic()
+    assert echo("a") == "a"
+    assert time.monotonic() - t0 < 5.0  # timeout flush, not a hang
+
+
+def test_batch_on_method():
+    class M:
+        def __init__(self):
+            self.calls = 0
+
+        @batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def run(self, xs):
+            self.calls += 1
+            return [x + 1 for x in xs]
+
+    m = M()
+    results, errors = _fan(lambda v: m.run(v), [1, 2, 3, 4])
+    assert not errors
+    assert sorted(results) == [2, 3, 4, 5]
+    assert m.calls <= 2
+
+
+def test_batch_wrong_length_raises_for_all():
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def broken(xs):
+        return [1]  # wrong length
+
+    results, errors = _fan(broken, [1, 2, 3, 4])
+    assert len(errors) == 4
+    assert all(isinstance(e, ValueError) for e in errors)
+
+
+def test_batch_error_fans_out():
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def boom(xs):
+        raise RuntimeError("nope")
+
+    _, errors = _fan(boom, [1, 2])
+    assert len(errors) == 2
+    assert all(isinstance(e, RuntimeError) for e in errors)
+
+
+def test_batch_tunable_handles():
+    @batch(max_batch_size=2, batch_wait_timeout_s=0.01)
+    def echo(xs):
+        return list(xs)
+
+    echo.set_max_batch_size(16)
+    echo.set_batch_wait_timeout_s(0.05)
+    q = echo._rt_batch_queue
+    assert q.max_batch_size == 16
+    assert q.batch_wait_timeout_s == 0.05
+
+
+def test_multiplex_lru_eviction():
+    loads = []
+
+    class Rep:
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            loads.append(model_id)
+            return f"model-{model_id}"
+
+    r = Rep()
+    assert r.get_model("a") == "model-a"
+    assert r.get_model("b") == "model-b"
+    assert r.get_model("a") == "model-a"  # cached, no reload
+    assert loads == ["a", "b"]
+    r.get_model("c")  # evicts LRU = "b"
+    assert loads == ["a", "b", "c"]
+    r.get_model("b")  # reload after eviction
+    assert loads == ["a", "b", "c", "b"]
+
+
+def test_multiplex_single_flight_load():
+    n_loads = []
+
+    class Rep:
+        @multiplexed(max_num_models_per_replica=4)
+        def get_model(self, model_id):
+            n_loads.append(model_id)
+            time.sleep(0.1)  # slow load: concurrent getters must coalesce
+            return model_id
+
+    r = Rep()
+    results, errors = _fan(lambda _: r.get_model("m"), [0] * 6)
+    assert not errors
+    assert results == ["m"] * 6
+    assert len(n_loads) == 1  # one load despite 6 concurrent callers
+
+
+def test_multiplex_reports_loaded_ids():
+    from ray_tpu.serve import multiplex as mux_mod
+
+    class Rep:
+        @multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            return model_id
+
+    r = Rep()
+    r.get_model("x")
+    r.get_model("y")
+    ids = mux_mod.loaded_model_ids()
+    assert "x" in ids and "y" in ids
+
+
+def test_aio_http_server_unary_and_keepalive():
+    import http.client
+    import json
+
+    from ray_tpu.serve.http_server import AioHttpServer
+
+    def handler(method, path, query, headers, body):
+        return 200, "application/json", json.dumps(
+            {"method": method, "path": path, "q": query,
+             "body": body.decode()}
+        ).encode()
+
+    srv = AioHttpServer(handler, port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        for i in range(5):  # keep-alive: same connection, many requests
+            conn.request("POST", f"/p{i}?k=v", body=f"b{i}")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            data = json.loads(resp.read())
+            assert data == {
+                "method": "POST", "path": f"/p{i}", "q": {"k": "v"},
+                "body": f"b{i}",
+            }
+    finally:
+        srv.stop()
+
+
+def test_aio_http_server_streaming():
+    import http.client
+
+    from ray_tpu.serve.http_server import AioHttpServer
+
+    def handler(method, path, query, headers, body):
+        def gen():
+            for i in range(4):
+                yield f"item{i}\n".encode()
+        return gen()
+
+    srv = AioHttpServer(handler, port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/stream")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        body = resp.read()  # http.client reassembles the chunks
+        assert body == b"item0\nitem1\nitem2\nitem3\n"
+    finally:
+        srv.stop()
+
+
+def test_aio_http_server_handler_error_is_500():
+    import http.client
+
+    from ray_tpu.serve.http_server import AioHttpServer
+
+    def handler(method, path, query, headers, body):
+        raise RuntimeError("boom")
+
+    srv = AioHttpServer(handler, port=0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        conn.request("GET", "/x")
+        resp = conn.getresponse()
+        assert resp.status == 500
+    finally:
+        srv.stop()
