@@ -24,6 +24,61 @@ def timed(fn, n, warmup=5):
     return n / dt, dt / n
 
 
+def _bench_serve_http() -> float:
+    """No-op deployment behind the asyncio proxy, hammered by concurrent
+    keep-alive connections (parity: reference serve microbenchmarks'
+    no-op HTTP throughput)."""
+    import http.client
+    import threading
+    import time as time_mod
+
+    from ray_tpu import serve
+
+    serve.start()
+
+    @serve.deployment(num_replicas=2, max_concurrency=16,
+                      route_prefix="/noop")
+    class Noop:
+        def __call__(self, request):
+            return b"ok"
+
+    serve.run(Noop.bind())
+    deadline = time_mod.monotonic() + 30
+    addrs = []
+    while time_mod.monotonic() < deadline and not addrs:
+        addrs = serve.proxy_addresses()
+        time_mod.sleep(0.2)
+    host, port = addrs[0].rsplit(":", 1)
+
+    N_CONNS, N_REQS = 16, 150
+    barrier = threading.Barrier(N_CONNS + 1)
+    done = threading.Barrier(N_CONNS + 1)
+
+    def client_loop():
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        conn.request("GET", "/noop")
+        conn.getresponse().read()  # warm the connection + replica
+        barrier.wait()
+        for _ in range(N_REQS):
+            conn.request("GET", "/noop")
+            conn.getresponse().read()
+        done.wait()
+
+    threads = [
+        threading.Thread(target=client_loop, daemon=True)
+        for _ in range(N_CONNS)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time_mod.perf_counter()
+    done.wait()
+    dt = time_mod.perf_counter() - t0
+    serve.delete("Noop")
+    serve.shutdown()
+    return N_CONNS * N_REQS / dt
+
+
 def main():
     import numpy as np
 
@@ -181,6 +236,10 @@ def main():
         record("compiled_dag_vs_rpc_speedup", rpc_lat / dag_lat, "x")
     finally:
         cdag.teardown()
+
+    # -- serve HTTP data plane (asyncio proxy) --------------------------
+    serve_reqs = _bench_serve_http()
+    record("serve_http_noop", serve_reqs, "req/s")
 
     # -- RDT device objects vs pickle path ------------------------------
     import jax

@@ -551,10 +551,15 @@ class ControlStore:
         self._sched_q.put(item)
 
     def _sched_retry(self, item: tuple, key: tuple) -> None:
-        """Re-enqueue after this key's (exponential, capped) backoff."""
+        """Re-enqueue after this key's (exponential, capped) backoff.
+        The 10s cap is a background anti-entropy poll, not the wake-up
+        path: capacity_freed kicks requeue parked items the moment a
+        lease frees, so thousands of unplaceable actors idle at ~0.1
+        pass/s each instead of hammering the dispatcher at the old 2s
+        cap (0.5 pass/s x 2000 pending saturated it)."""
         with self._sched_retry_lock:
             backoff = self._sched_backoff.get(key, 0.05)
-            self._sched_backoff[key] = min(backoff * 2, 2.0)
+            self._sched_backoff[key] = min(backoff * 2, 10.0)
             heapq.heappush(
                 self._sched_retries,
                 (time.monotonic() + backoff, next(self._sched_seq), item),
@@ -771,11 +776,26 @@ class ControlStore:
             record = self._actors.get(actor_id)
             if record is None:
                 return
-            record["state"] = ActorState.ALIVE
-            record["node_id"] = node_id
-            record["worker_address"] = lease["worker_address"]
-            record["lease_id"] = lease["lease_id"]
-            record["agent_address"] = agent_addr
+            if record["state"] == ActorState.DEAD:
+                # killed while the creation push was in flight: the reply
+                # must NOT resurrect it — tear the fresh worker down
+                # (kill_actor found no worker_address to clean up yet)
+                dead = True
+            else:
+                dead = False
+                record["state"] = ActorState.ALIVE
+                record["node_id"] = node_id
+                record["worker_address"] = lease["worker_address"]
+                record["lease_id"] = lease["lease_id"]
+                record["agent_address"] = agent_addr
+        if dead:
+            try:
+                self._agents.get(agent_addr).call_oneway(
+                    "release_worker", lease_id=lease["lease_id"], kill=True
+                )
+            except RpcError:
+                pass
+            return
         self._sched_backoff.pop(("actor", actor_id), None)
         self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
         self.publish("actor", self._public_actor(actor_id))

@@ -22,9 +22,11 @@ TPU-native design (NOT a port of the torch/NCCL machinery):
        the value → the stored pytree is returned as-is (zero copy, the
        arrays never leave HBM; mutations are visible, exactly like the
        reference's documented RDT aliasing semantics).
-    2. **cross-process**: raw device buffer bytes are pulled over the
-       worker RPC plane (device→host DMA, framed TCP, host→device
-       ``jax.device_put``) — tensor data never passes through pickle.
+    2. **cross-process**: the holder exports raw leaf bytes ONCE into an
+       agent shm segment (worker.py _export_device_segment); same-host
+       consumers mmap it, cross-host consumers stream it over the
+       sendfile data plane, then ``jax.device_put`` — tensor data never
+       passes through pickle.
   A jax.experimental.transfer (TransferServer) backend — true NIC/ICI DMA
   between jax clients, the NIXL analogue — slots in here once jaxlib's
   same-host path stops aborting (tracked: LocalBulkTransportFactory
@@ -172,15 +174,6 @@ class DeviceObjectStore:
             arrays, skeleton, _ = self._objects[obj_hex]
         return join_device_value(skeleton, arrays)
 
-    def fetch_leaves(self, obj_hex: str) -> List[bytes]:
-        """Cross-process read: raw buffer bytes per array leaf (device →
-        host DMA; the bytes ride the RPC frame without pickling)."""
-        import numpy as np
-
-        with self._lock:
-            arrays, _, _ = self._objects[obj_hex]
-        return [np.asarray(a).tobytes() for a in arrays]
-
     def arrays(self, obj_hex: str) -> List[Any]:
         """The live device arrays (for the shm/data-plane export path)."""
         with self._lock:
@@ -220,15 +213,3 @@ class DeviceObjectStore:
         return {"device_objects": len(objs), "device_bytes": total}
 
 
-def materialize_leaves(
-    leaves_meta: List[Tuple[Tuple[int, ...], str]], raw: List[bytes]
-) -> List[Any]:
-    """host bytes → device arrays on the consumer's default device."""
-    import jax
-    import numpy as np
-
-    out = []
-    for (shape, dtype), buf in zip(leaves_meta, raw):
-        arr = np.frombuffer(buf, dtype=np.dtype(dtype)).reshape(shape)
-        out.append(jax.device_put(arr))
-    return out

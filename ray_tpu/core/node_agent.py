@@ -570,13 +570,13 @@ class NodeAgent:
                     return {"granted": False, "error": "bundle not found"}
         deadline = time.monotonic() + wait_s
         kind = "tpu" if resources.get("TPU") else "cpu"
-        owner_conn_id = id(conn) if (bind_to_conn and conn is not None) else None
+        owner_conn = conn if (bind_to_conn and conn is not None) else None
         return self._lease_wait(
-            resources, bundle, deadline, kind, strategy, owner_conn_id
+            resources, bundle, deadline, kind, strategy, owner_conn
         )
 
     def _lease_wait(self, resources, bundle, deadline, kind, strategy=None,
-                    owner_conn_id=None):
+                    owner_conn=None):
         spawned_for_me = False
         starved = False  # counted toward autoscaler demand
         last_spill_check = time.monotonic()
@@ -599,6 +599,15 @@ class NodeAgent:
                     )
                     self._starved_shapes[shape_key] = time.monotonic()
                 if ok:
+                    if owner_conn is not None and not owner_conn.alive:
+                        # the owner disconnected while this request waited
+                        # — its reap callback has already run, so a grant
+                        # now would register an unreapable (stranded)
+                        # lease
+                        self._deallocate_locked(resources, resolved_bundle)
+                        return {
+                            "granted": False, "error": "owner disconnected",
+                        }
                     worker = self._pop_idle_worker_locked(kind)
                     if worker is not None:
                         lease_id = uuid.uuid4().hex
@@ -608,8 +617,15 @@ class NodeAgent:
                             "resources": resources,
                             "bundle": resolved_bundle,
                             "worker_id": worker.worker_id,
-                            "conn_id": owner_conn_id,
+                            "conn_id": (
+                                id(owner_conn)
+                                if owner_conn is not None else None
+                            ),
                         }
+                        # no re-check needed: we hold self._lock from the
+                        # liveness check through this insert, and the reap
+                        # scan (_owner_conn_closed) needs the same lock —
+                        # a disconnect after the check reaps post-insert
                         return {
                             "granted": True,
                             "worker_address": worker.address,

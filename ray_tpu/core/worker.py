@@ -1985,7 +1985,19 @@ class CoreWorker:
             return None
         with rt.running_lock:
             running = rt.running
-        return {"queued": rt.queue.qsize(), "running": running}
+        out = {"queued": rt.queue.qsize(), "running": running}
+        # serve model multiplexing: piggyback the replica's loaded model
+        # ids on the out-of-band probe (no extra RPC, and no import cost
+        # unless the process actually uses @serve.multiplexed)
+        import sys as _sys
+
+        mux = _sys.modules.get("ray_tpu.serve.multiplex")
+        if mux is not None:
+            try:
+                out["multiplexed_model_ids"] = mux.loaded_model_ids()
+            except Exception:  # noqa: BLE001 — stats must never fail
+                pass
+        return out
 
     def rpc_create_actor(self, conn, spec: Dict[str, Any]):
         """Returns {"ok": True} or {"ok": False, "error": TaskError}.
@@ -2326,16 +2338,6 @@ class CoreWorker:
             "offsets": offsets,
             "agent_addr": self.node_agent_address,
         }
-
-    def rpc_fetch_device_object(self, conn, obj_hex: str):
-        """Serve a device object's raw leaf buffers to a remote consumer
-        (device→host DMA here; host→device device_put at the consumer)."""
-        if self._device_store is None or not self._device_store.contains(obj_hex):
-            return None
-        try:
-            return self._device_store.fetch_leaves(obj_hex)
-        except KeyError:
-            return None
 
     def rpc_device_object_contains(self, conn, obj_hex: str):
         return (
@@ -2687,6 +2689,7 @@ class _NormalTaskSubmitter:
         # _on_done takes the lock — arming under it would self-deadlock)
         self._to_arm: List[tuple] = []
         self._arming = threading.local()
+        self._sender_kicked = False
         self._empty_since: Optional[float] = None
         self._disposed = False
 
@@ -2698,14 +2701,39 @@ class _NormalTaskSubmitter:
                 return False
             self.pending.append(spec)
             self._flow_locked()
-        self._arm_callbacks()
+        # sends go to the pool, NOT inline: a caller submitting a burst
+        # must not pay serialize+sendall per task — while the pool sender
+        # works, later submits queue up and coalesce into fatter chunks
+        # (replies, by contrast, send their next chunk inline to keep the
+        # worker pipeline tight)
+        self._kick_sender()
         return True
 
+    def _kick_sender(self) -> None:
+        with self.lock:
+            if not self._to_arm or self._sender_kicked:
+                return
+            self._sender_kicked = True
+        self.w._submit_pool.submit(self._drain_sends)
+
+    def _drain_sends(self) -> None:
+        try:
+            self._arm_callbacks()
+        finally:
+            with self.lock:
+                self._sender_kicked = False
+            # items planned after _arm_callbacks drained but before the
+            # flag cleared would strand: re-kick if any
+        self._kick_sender()
+
     def _arm_callbacks(self) -> None:
-        """Register done-callbacks for freshly dispatched calls. Runs
-        with the lock RELEASED; reentrancy-guarded because a
-        synchronously-completed reply runs _on_done inline, which can
-        dispatch more tasks and land back here."""
+        """Perform the actual sends for chunks the state machine planned
+        under the lock. Runs with the lock RELEASED — the serialize +
+        sendall of a push (~100us) must not sit in the critical section,
+        where it would serialize every submitting thread against every
+        reply thread. Reentrancy-guarded: a synchronously-completed reply
+        runs _on_done inline, which can plan more sends and land back
+        here."""
         if getattr(self._arming, "active", False):
             return  # the outer frame's drain loop will pick new items up
         self._arming.active = True
@@ -2715,20 +2743,68 @@ class _NormalTaskSubmitter:
                     if not self._to_arm:
                         return
                     items, self._to_arm = self._to_arm, []
-                for pending, spec, lease in items:
-                    pending.add_done_callback(
-                        lambda p, s=spec, l=lease: self._on_done(p, s, l)
-                    )
+                for lease in items:
+                    self._send_chunk(lease)
         finally:
             self._arming.active = False
+
+    def _send_chunk(self, lease: _Lease) -> None:
+        """Bind up to a chunk of queued specs to this reserved lease and
+        push them in one RPC. Runs OUTSIDE the lock (serialize+sendall
+        must not serialize submitters against reply threads)."""
+        w = self.w
+        with self.lock:
+            specs = self._take_chunk_locked()
+            if not specs:
+                # queue drained before this reservation got serviced
+                self.nbusy -= 1
+                lease.idle_since = time.monotonic()
+                self.idle.append(lease)
+                return
+            now = time.monotonic()
+            for spec in specs:
+                w._inflight_push[spec.task_id.hex()] = lease.worker_addr
+                self._dispatch_ts[spec.task_id.hex()] = now
+        try:
+            client = lease.client
+            if client is None:
+                client = lease.client = w.workers.get(lease.worker_addr)
+            pending = client.call_async("push_tasks", specs=specs)
+        except (RpcError, OSError):
+            w.workers.drop(lease.worker_addr)
+            # release off-thread: a dead agent must not stall this
+            # (submit or reply) thread for a connect timeout
+            w._submit_pool.submit(self._release, lease, True)
+            with self.lock:
+                self.nbusy -= 1
+                for spec in specs:
+                    w._inflight_push.pop(spec.task_id.hex(), None)
+                    self._dispatch_ts.pop(spec.task_id.hex(), None)
+                    self._retry_or_fail_locked(
+                        spec,
+                        WorkerCrashedError(
+                            f"worker {lease.worker_addr} unreachable for "
+                            f"{spec.name}"
+                        ),
+                    )
+                self._flow_locked()
+            return
+        pending.add_done_callback(
+            lambda p, s=specs, l=lease: self._on_done(p, s, l)
+        )
 
     # -- state machine (lock held) --------------------------------------
 
     def _flow_locked(self) -> None:
-        """Dispatch queued specs onto idle leases, then size the pool."""
+        """Reserve idle leases for queued specs, then size the pool. A
+        reservation carries the LEASE only — the specs are taken at SEND
+        time (_send_chunk), so during a submit flood the (slower, pooled)
+        sender finds a fattened queue and coalesces many specs per RPC
+        instead of freezing chunk boundaries at plan time."""
         while self.pending and self.idle:
             lease = self.idle.pop()  # LIFO: warmest worker first
-            self._dispatch_locked(self._take_chunk_locked(), lease)
+            self.nbusy += 1
+            self._to_arm.append(lease)
         self._scale_locked()
 
     def _take_chunk_locked(self) -> List[TaskSpec]:
@@ -2736,16 +2812,27 @@ class _NormalTaskSubmitter:
         sub-ms function coalesce (the ~100us frame roundtrip dominates
         them); anything slower — or not yet measured — goes one-per-RPC
         so a slow task never executes serially behind batch peers. A
-        batch stops at a fn whose profile differs."""
+        batch stops at a fn whose profile differs. Cancelled specs are
+        consumed here (error stored) without entering the chunk."""
+        w = self.w
+        chunk: List[TaskSpec] = []
         cap = min(16, max(1, len(self.pending) // (len(self.idle) + 1)))
-        chunk = [self.pending.popleft()]
-        if self._fn_lat.get(chunk[0].fn_id, 0.01) >= 0.005:
-            return chunk
-        while (
-            len(chunk) < cap
-            and self.pending
-            and self._fn_lat.get(self.pending[0].fn_id, 0.01) < 0.005
-        ):
+        while self.pending and len(chunk) < cap:
+            spec = self.pending[0]
+            task_hex = spec.task_id.hex()
+            if task_hex in w._cancelled_tasks:
+                self.pending.popleft()
+                self.attempts.pop(task_hex, None)
+                w._store_error_returns(
+                    spec,
+                    TaskCancelledError(f"task {spec.name} was cancelled"),
+                )
+                continue
+            lat = self._fn_lat.get(spec.fn_id, 0.01)
+            if lat >= 0.005:
+                if not chunk:
+                    chunk.append(self.pending.popleft())
+                break  # slow fn: alone in its RPC, never behind peers
             chunk.append(self.pending.popleft())
         return chunk
 
@@ -2798,56 +2885,6 @@ class _NormalTaskSubmitter:
                 self.w._submit_pool.submit(self._acquire_lease)
             if fired:
                 self._next_request_at = now + 0.05
-
-    def _dispatch_locked(self, specs: List[TaskSpec], lease: _Lease) -> None:
-        """Push a chunk of specs onto `lease`'s worker in one RPC. On a
-        send failure the lease is dead; every spec goes through retry
-        accounting."""
-        w = self.w
-        live = []
-        for spec in specs:
-            task_hex = spec.task_id.hex()
-            if task_hex in w._cancelled_tasks:
-                self.attempts.pop(task_hex, None)
-                w._store_error_returns(
-                    spec,
-                    TaskCancelledError(f"task {spec.name} was cancelled"),
-                )
-            else:
-                live.append(spec)
-        if not live:
-            lease.idle_since = time.monotonic()
-            self.idle.append(lease)
-            return
-        for spec in live:
-            w._inflight_push[spec.task_id.hex()] = lease.worker_addr
-        try:
-            client = lease.client
-            if client is None:
-                client = lease.client = w.workers.get(lease.worker_addr)
-            pending = client.call_async("push_tasks", specs=live)
-        except (RpcError, OSError):
-            w.workers.drop(lease.worker_addr)
-            # release off-lock: _dispatch_locked runs under self.lock and
-            # _release opens a connection to the agent — a dead agent
-            # would wedge every submit/reply for the key for the full
-            # connect timeout
-            w._submit_pool.submit(self._release, lease, True)
-            for spec in live:
-                w._inflight_push.pop(spec.task_id.hex(), None)
-                self._retry_or_fail_locked(
-                    spec,
-                    WorkerCrashedError(
-                        f"worker {lease.worker_addr} unreachable for "
-                        f"{spec.name}"
-                    ),
-                )
-            return
-        self.nbusy += 1
-        now = time.monotonic()
-        for spec in live:
-            self._dispatch_ts[spec.task_id.hex()] = now
-        self._to_arm.append((pending, live, lease))
 
     def _retry_or_fail_locked(self, spec: TaskSpec, err: Exception) -> None:
         """Mirror of the pre-cache retry ladder (_submit_normal_task):
@@ -2946,14 +2983,18 @@ class _NormalTaskSubmitter:
                 self._flow_locked()
             self._arm_callbacks()
             return
-        # healthy worker: pipeline the next queued chunk onto it NOW
+        # healthy worker: pipeline the next queued chunk onto it NOW —
+        # inline on this reply thread, which keeps the worker's pipeline
+        # tight (the submit path, by contrast, offloads sends to the pool)
         with self.lock:
-            if self.pending:
-                self._dispatch_locked(self._take_chunk_locked(), lease)
+            reserved = bool(self.pending)
+            if reserved:
+                self.nbusy += 1
             else:
                 lease.idle_since = time.monotonic()
                 self.idle.append(lease)
-        self._arm_callbacks()
+        if reserved:
+            self._send_chunk(lease)
         retry = []
         for spec, reply in zip(specs, replies):
             task_hex = spec.task_id.hex()

@@ -18,6 +18,8 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import multiplexed
 from ray_tpu.serve.replica import Request
 
 __all__ = [
@@ -25,9 +27,11 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "Request",
+    "batch",
     "delete",
     "deployment",
     "get_deployment_handle",
+    "multiplexed",
     "proxy_addresses",
     "run",
     "shutdown",

@@ -186,6 +186,15 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str):
         self.deployment_name = deployment_name
+        self._model_id: Optional[str] = None
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """Reference handle.options(multiplexed_model_id=...) parity:
+        route the call to a replica already holding this model."""
+        h = DeploymentHandle(self.deployment_name)
+        h._model_id = multiplexed_model_id
+        return h
 
     def _router(self):
         global _local_router
@@ -199,7 +208,10 @@ class DeploymentHandle:
     def remote(self, payload: Any = None, *,
                method: Optional[str] = None) -> DeploymentResponse:
         router = self._router()
-        rid, ref = router.assign(self.deployment_name, payload, method)
+        rid, ref = router.assign(
+            self.deployment_name, payload, method,
+            model_id=self._model_id,
+        )
         return DeploymentResponse(
             router, self.deployment_name, payload, method, rid, ref
         )

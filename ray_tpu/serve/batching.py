@@ -18,6 +18,13 @@ else parks on their item's event. Matches the reference's semantics:
 - ``max_batch_size`` / ``batch_wait_timeout_s`` are tunable at decoration
   time and via ``set_max_batch_size`` / ``set_batch_wait_timeout_s``
   handles (reference batching.py set_* parity).
+
+State is created LAZILY and PER INSTANCE (method case): deployments ship
+to replicas via pickle, so threading primitives must not live in the
+decorator closure — and two instances of one class must never share a
+queue (a batch would execute with the wrong ``self``). The config dict is
+read live by the queue, so driver-side ``set_*`` calls before deployment
+never materialize unpicklable state.
 """
 
 from __future__ import annotations
@@ -26,6 +33,8 @@ import functools
 import threading
 import time
 from typing import Any, Callable, List, Optional
+
+_LAZY_LOCK = threading.Lock()
 
 
 class _Item:
@@ -39,11 +48,9 @@ class _Item:
 
 
 class _BatchQueue:
-    def __init__(self, fn: Callable[[List[Any]], List[Any]],
-                 max_batch_size: int, batch_wait_timeout_s: float):
+    def __init__(self, fn: Callable[..., List[Any]], cfg: dict):
         self.fn = fn
-        self.max_batch_size = max_batch_size
-        self.batch_wait_timeout_s = batch_wait_timeout_s
+        self.cfg = cfg  # read live: set_* updates apply to running queues
         self._lock = threading.Lock()
         self._items: List[_Item] = []
         self._flusher_active = False
@@ -51,6 +58,14 @@ class _BatchQueue:
         # observability (reference exposes batch utilization metrics)
         self.num_batches = 0
         self.batch_sizes: List[int] = []
+
+    @property
+    def max_batch_size(self) -> int:
+        return int(self.cfg["max_batch_size"])
+
+    @property
+    def batch_wait_timeout_s(self) -> float:
+        return float(self.cfg["batch_wait_timeout_s"])
 
     def call(self, instance, value) -> Any:
         item = _Item(value)
@@ -86,8 +101,8 @@ class _BatchQueue:
             )
             self._flusher_active = False
             if self._items:
-                # leftovers: promote a new flusher via the next call —
-                # wake a parked caller so ITS thread takes over
+                # leftovers: promote a new flusher thread (same instance —
+                # one queue serves exactly one instance)
                 self._flusher_active = True
                 threading.Thread(
                     target=self._flush_when_ready, args=(instance,),
@@ -133,22 +148,44 @@ def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 8,
     """
 
     def wrap(fn):
-        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+        cfg = {
+            "max_batch_size": max_batch_size,
+            "batch_wait_timeout_s": batch_wait_timeout_s,
+        }
+        attr = f"_rt_batch_queue__{fn.__name__}"
+        state: dict = {}  # free-function case only
+
+        def queue_for(instance) -> _BatchQueue:
+            # import-at-call: referencing module globals directly would
+            # drag a _thread.lock into this (pickled-by-value) closure
+            from ray_tpu.serve import batching as _mod
+
+            holder = instance.__dict__ if instance is not None else state
+            q = holder.get(attr)
+            if q is None:
+                with _mod._LAZY_LOCK:
+                    q = holder.get(attr)
+                    if q is None:
+                        q = holder[attr] = _mod._BatchQueue(fn, cfg)
+            return q
 
         @functools.wraps(fn)
         def inner(self_or_first, *rest):
             # method: inner(self, request); free function: inner(request)
             if rest:
-                return queue.call(self_or_first, rest[0])
-            return queue.call(None, self_or_first)
+                return queue_for(self_or_first).call(self_or_first, rest[0])
+            return queue_for(None).call(None, self_or_first)
 
-        inner._rt_batch_queue = queue
-        inner.set_max_batch_size = (
-            lambda n: setattr(queue, "max_batch_size", int(n))
-        )
-        inner.set_batch_wait_timeout_s = (
-            lambda s: setattr(queue, "batch_wait_timeout_s", float(s))
-        )
+        def set_max_batch_size(n):
+            cfg["max_batch_size"] = int(n)
+
+        def set_batch_wait_timeout_s(s):
+            cfg["batch_wait_timeout_s"] = float(s)
+
+        inner._rt_batch_cfg = cfg
+        inner._rt_batch_queue_for = queue_for
+        inner.set_max_batch_size = set_max_batch_size
+        inner.set_batch_wait_timeout_s = set_batch_wait_timeout_s
         return inner
 
     if _fn is not None:

@@ -118,6 +118,9 @@ class ServeController:
                                     "ongoing": dep["stats"].get(rid, {}).get(
                                         "ongoing", 0
                                     ),
+                                    "model_ids": dep["stats"].get(
+                                        rid, {}
+                                    ).get("model_ids", []),
                                     "handle_info": rec["handle_info"],
                                 }
                                 for rid, rec in dep["replicas"].items()
@@ -233,6 +236,7 @@ class ServeController:
                 with self._lock:
                     dep["stats"][rid] = {
                         "ongoing": stats["queued"] + stats["running"],
+                        "model_ids": stats.get("multiplexed_model_ids", []),
                     }
                     rec["probe_misses"] = 0
                     if not rec["healthy"]:

@@ -98,7 +98,13 @@ class AioHttpServer:
                 except ValueError:
                     await self._simple(writer, 400, b'{"error":"bad request"}')
                     return
-                length = int(headers.get("content-length") or 0)
+                try:
+                    length = int(headers.get("content-length") or 0)
+                    if length < 0:
+                        raise ValueError
+                except ValueError:
+                    await self._simple(writer, 400, b'{"error":"bad content-length"}')
+                    return
                 if length > _MAX_BODY:
                     await self._simple(writer, 413, b'{"error":"body too large"}')
                     return

@@ -112,17 +112,40 @@ def multiplexed(_fn: Optional[Callable] = None, *,
     """
 
     def wrap(fn):
-        mux = _Multiplexer(fn, max_num_models_per_replica)
-        with _REGISTRY_LOCK:
-            _REGISTRY[id(mux)] = mux
+        # The multiplexer (with its locks) is created LAZILY and PER
+        # INSTANCE: deployments ship to replicas via pickle (threading
+        # primitives must stay out of the closure), and two instances of
+        # one class must not share an LRU — a model loaded with r1's self
+        # must never be served for r2. Lazy creation also lands the
+        # _REGISTRY entry in the REPLICA process, where the loaded-model
+        # stats belong.
+        attr = f"_rt_multiplexer__{fn.__name__}"
+        state: dict = {}  # free-function case only
+
+        def mux_for(instance) -> _Multiplexer:
+            # import-at-call: referencing the module lock directly would
+            # drag a _thread.lock into this (pickled-by-value) closure
+            from ray_tpu.serve import multiplex as _mod
+
+            holder = instance.__dict__ if instance is not None else state
+            m = holder.get(attr)
+            if m is None:
+                with _mod._REGISTRY_LOCK:
+                    m = holder.get(attr)
+                    if m is None:
+                        m = holder[attr] = _mod._Multiplexer(
+                            fn, max_num_models_per_replica
+                        )
+                        _mod._REGISTRY[id(m)] = m
+            return m
 
         @functools.wraps(fn)
         def inner(self_or_id, *rest):
             if rest:
-                return mux.get(self_or_id, rest[0])
-            return mux.get(None, self_or_id)
+                return mux_for(self_or_id).get(self_or_id, rest[0])
+            return mux_for(None).get(None, self_or_id)
 
-        inner._rt_multiplexer = mux
+        inner._rt_multiplexer_for = mux_for
         return inner
 
     if _fn is not None:

@@ -3,20 +3,28 @@
 Parity: the reference ProxyActor/HTTPProxy (python/ray/serve/_private/
 proxy.py:1176,827): one proxy per node accepts HTTP, matches the route
 prefix, routes to a replica (pow-2 router) and returns the response.
-Implemented on the stdlib ThreadingHTTPServer — request handling threads
-block on the replica call, the actor's own RPC threads stay free.
+
+Data plane: asyncio (ray_tpu/serve/http_server.py) — the reference's
+proxy is ASGI/asyncio (proxy.py:732), and the round-4 review flagged the
+previous thread-per-request stdlib server as the gap. Connections are
+event-driven with keep-alive; the blocking replica call runs on a
+bounded pool; ?stream=1 responses ride chunked transfer encoding.
+
+Model multiplexing: a request carrying a ``serve_multiplexed_model_id``
+header (or ``model_id`` query param) is routed preferentially to a
+replica that already holds that model (reference multiplex routing).
 """
 
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import parse_qsl, urlparse
 
 import ray_tpu
+from ray_tpu.serve.http_server import AioHttpServer
 from ray_tpu.serve.replica import Request
+
+_MODEL_ID_HEADER = "serve_multiplexed_model_id"
 
 
 @ray_tpu.remote
@@ -26,96 +34,49 @@ class ServeProxy:
 
         controller = ray_tpu.get_actor(controller_name)
         self._router = Router(controller)
-        proxy = self
+        self._server = AioHttpServer(self._handle, port=port)
 
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
+    # -- request path (runs on the server's executor pool) --------------
 
-            def log_message(self, fmt, *args):  # quiet
-                pass
+    def _handle(self, method: str, path: str, query, headers, body: bytes):
+        if query.get("stream") in ("1", "true"):
+            return self._handle_streaming(method, path, query, headers, body)
+        try:
+            status, payload = self._dispatch(method, path, query, headers, body)
+        except TimeoutError as e:
+            status, payload = 503, json.dumps({"error": str(e)}).encode()
+        except Exception as e:  # noqa: BLE001 — app errors -> 500
+            status, payload = 500, json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}
+            ).encode()
+        return status, "application/json", payload
 
-            def _handle(self, method: str):
-                parsed = urlparse(self.path)
-                query = dict(parse_qsl(parsed.query))
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
-                if query.get("stream") in ("1", "true"):
-                    return self._handle_streaming(method, parsed.path,
-                                                  query, body)
-                try:
-                    status, payload = proxy._dispatch(
-                        method, parsed.path, query,
-                        dict(self.headers), body,
+    def _handle_streaming(self, method, path, query, headers, body):
+        """?stream=1: a generator — the asyncio server turns each yielded
+        item into one chunk (reference proxy's streaming response path)."""
+        deployment = self._router.deployment_for_route(path)
+        if deployment is None:
+            return 404, "application/json", json.dumps(
+                {"error": f"no route for {path}"}
+            ).encode()
+        request = Request(method, path, body, headers, query)
+
+        def gen():
+            try:
+                for item in self._router.call_streaming(
+                    deployment, request, timeout_s=300
+                ):
+                    line = (
+                        item if isinstance(item, bytes)
+                        else json.dumps(item).encode()
                     )
-                except TimeoutError as e:
-                    status, payload = 503, json.dumps(
-                        {"error": str(e)}
-                    ).encode()
-                except Exception as e:  # noqa: BLE001 — app errors -> 500
-                    status, payload = 500, json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}
-                    ).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+                    yield line + b"\n"
+            except Exception as e:  # noqa: BLE001 — trailer chunk
+                yield json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}
+                ).encode() + b"\n"
 
-            def _handle_streaming(self, method, path, query, body):
-                """?stream=1: chunked transfer encoding, one JSON line per
-                streamed item (the reference proxy's streaming response
-                path over starlette; here raw HTTP/1.1 chunks)."""
-                deployment = proxy._router.deployment_for_route(path)
-                if deployment is None:
-                    payload = json.dumps({"error": f"no route for {path}"}).encode()
-                    self.send_response(404)
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", "application/x-ndjson")
-                self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-
-                def chunk(data: bytes):
-                    self.wfile.write(f"{len(data):x}\r\n".encode())
-                    self.wfile.write(data + b"\r\n")
-
-                try:
-                    request = Request(method, path, body, {}, query)
-                    for item in proxy._router.call_streaming(
-                        deployment, request, timeout_s=300
-                    ):
-                        line = (
-                            item if isinstance(item, bytes)
-                            else json.dumps(item).encode()
-                        )
-                        chunk(line + b"\n")
-                        self.wfile.flush()
-                except Exception as e:  # noqa: BLE001 — trailer chunk
-                    chunk(json.dumps(
-                        {"error": f"{type(e).__name__}: {e}"}
-                    ).encode() + b"\n")
-                self.wfile.write(b"0\r\n\r\n")
-
-            def do_GET(self):
-                self._handle("GET")
-
-            def do_POST(self):
-                self._handle("POST")
-
-            def do_PUT(self):
-                self._handle("PUT")
-
-            def do_DELETE(self):
-                self._handle("DELETE")
-
-        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="serve-http", daemon=True
-        )
-        self._thread.start()
+        return gen()
 
     def _dispatch(self, method: str, path: str, query, headers, body: bytes):
         if path == "/-/routes":
@@ -131,8 +92,13 @@ class ServeProxy:
         deployment = self._router.deployment_for_route(path)
         if deployment is None:
             return 404, json.dumps({"error": f"no route for {path}"}).encode()
+        model_id: Optional[str] = (
+            headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
+        )
         request = Request(method, path, body, headers, query)
-        result = self._router.call(deployment, request, timeout_s=120)
+        result = self._router.call(
+            deployment, request, timeout_s=120, model_id=model_id
+        )
         if isinstance(result, bytes):
             return 200, result
         return 200, json.dumps(result).encode()
@@ -140,11 +106,10 @@ class ServeProxy:
     def address(self) -> str:
         from ray_tpu.core import worker as worker_mod
 
-        port = self._server.server_address[1]
         # the node's routable address, not loopback: multi-node clients
         # must be able to reach every node's proxy
         host = worker_mod.global_worker().node_agent_address.split(":")[0]
-        return f"{host}:{port}"
+        return f"{host}:{self._server.port}"
 
     def health(self) -> bool:
         return True

@@ -94,14 +94,27 @@ class Router:
                         best = name
             return best
 
-    def choose_replica(self, deployment: str, timeout_s: float = 30.0):
-        """Pow-2 choice; blocks (re-polling) until a replica exists."""
+    def choose_replica(self, deployment: str, timeout_s: float = 30.0,
+                       model_id: Optional[str] = None):
+        """Pow-2 choice; blocks (re-polling) until a replica exists.
+        With a multiplexed ``model_id``, replicas already holding that
+        model are preferred (reference multiplex routing hint) — traffic
+        for one model stays warm on one replica instead of thrashing
+        every replica's LRU; when nobody holds it, normal pow-2 picks the
+        replica that will load it."""
         deadline = time.monotonic() + timeout_s
         while True:
             self._refresh()
             with self._lock:
                 dep = self._table.get(deployment)
                 replicas = list(dep["replicas"]) if dep else []
+                if replicas and model_id:
+                    holding = [
+                        r for r in replicas
+                        if model_id in r.get("model_ids", [])
+                    ]
+                    if holding:
+                        replicas = holding
                 if replicas:
                     if len(replicas) == 1:
                         chosen = replicas[0]
@@ -133,9 +146,10 @@ class Router:
                 self._local_inflight[replica_id] = n
 
     def assign(self, deployment: str, payload: Any,
-               method: Optional[str] = None, timeout_s: float = 30.0):
+               method: Optional[str] = None, timeout_s: float = 30.0,
+               model_id: Optional[str] = None):
         """Route one request; returns (replica_id, result ObjectRef)."""
-        rid, handle = self.choose_replica(deployment, timeout_s)
+        rid, handle = self.choose_replica(deployment, timeout_s, model_id)
         if method:
             return rid, handle.handle_request.remote(payload, method=method)
         return rid, handle.handle_request.remote(payload)
@@ -158,7 +172,8 @@ class Router:
             self.request_finished(rid)
 
     def call(self, deployment: str, payload: Any,
-             method: Optional[str] = None, timeout_s: float = 60.0) -> Any:
+             method: Optional[str] = None, timeout_s: float = 60.0,
+             model_id: Optional[str] = None) -> Any:
         """Route + get with retry on replica death: the routing table lags
         replica failures by up to a health-check period, so a request that
         lands on a corpse is transparently re-routed (reference: the
@@ -172,7 +187,9 @@ class Router:
         last_exc: Optional[BaseException] = None
         for _ in range(4):
             remaining = max(0.5, deadline - time.monotonic())
-            rid, ref = self.assign(deployment, payload, method, remaining)
+            rid, ref = self.assign(
+                deployment, payload, method, remaining, model_id
+            )
             try:
                 return ray_tpu.get(ref, timeout=remaining)
             except (ActorDiedError, ActorUnavailableError) as e:

@@ -102,7 +102,7 @@ def test_batch_tunable_handles():
 
     echo.set_max_batch_size(16)
     echo.set_batch_wait_timeout_s(0.05)
-    q = echo._rt_batch_queue
+    q = echo._rt_batch_queue_for(None)
     assert q.max_batch_size == 16
     assert q.batch_wait_timeout_s == 0.05
 
@@ -226,3 +226,142 @@ def test_aio_http_server_handler_error_is_500():
         assert resp.status == 500
     finally:
         srv.stop()
+
+
+def test_multiplexed_deployment_over_http():
+    """End-to-end: a multiplexed deployment behind the asyncio proxy; the
+    serve_multiplexed_model_id header selects the model, repeated traffic
+    for one id loads it once (LRU warm)."""
+    import json as json_mod
+    import time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        serve.start()
+
+        @serve.deployment(num_replicas=1, route_prefix="/mux")
+        class Mux:
+            def __init__(self):
+                self.loads = []
+
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id):
+                self.loads.append(model_id)
+                return lambda body: {"model": model_id, "loads": len(self.loads)}
+
+            def __call__(self, request):
+                mid = request.headers.get(
+                    "serve_multiplexed_model_id"
+                ) or request.query.get("model_id") or "default"
+                return self.get_model(mid)(request.body)
+
+        serve.run(Mux.bind())
+        deadline = time.monotonic() + 30
+        addrs = []
+        while time.monotonic() < deadline and not addrs:
+            addrs = serve.proxy_addresses()
+            time.sleep(0.2)
+        assert addrs
+
+        def call(model_id):
+            req = urllib.request.Request(
+                f"http://{addrs[0]}/mux", data=b"{}",
+                headers={"serve_multiplexed_model_id": model_id},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json_mod.loads(r.read())
+
+        out1 = call("m1")
+        assert out1["model"] == "m1" and out1["loads"] == 1
+        for _ in range(3):
+            out = call("m1")
+        assert out["loads"] == 1  # warm: no reload
+        out2 = call("m2")
+        assert out2["model"] == "m2" and out2["loads"] == 2
+        serve.delete("Mux")
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+def test_batched_deployment_over_handle():
+    """@serve.batch inside a deployment with max_concurrency: concurrent
+    handle calls coalesce into vectorized executions."""
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        serve.start()
+
+        @serve.deployment(num_replicas=1, max_concurrency=16,
+                          route_prefix="/b")
+        class B:
+            def __init__(self):
+                self.batches = []
+
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+            def run(self, xs):
+                self.batches.append(len(xs))
+                return [x * 2 for x in xs]
+
+            def __call__(self, request):
+                return {"out": self.run(request.json()["x"]),
+                        "max_batch": max(self.batches)}
+
+        serve.run(B.bind())
+        h = serve.get_deployment_handle("B")
+        refs = [
+            h.remote(serve.Request("POST", "/b", b'{"x": %d}' % i))
+            for i in range(8)
+        ]
+        outs = [r.result(timeout_s=60) for r in refs]
+        assert sorted(o["out"] for o in outs) == [i * 2 for i in range(8)]
+        assert max(o["max_batch"] for o in outs) >= 2  # coalesced
+        serve.delete("B")
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
+
+
+def test_batch_queues_are_per_instance():
+    """Two instances must not share a queue: a batch executes with ONE
+    self, so cross-instance sharing would run B's requests on A."""
+    class M:
+        def __init__(self, name):
+            self.name = name
+
+        @batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        def run(self, xs):
+            return [self.name for _ in xs]
+
+    a, b = M("a"), M("b")
+    results, errors = _fan(
+        lambda v: (a if v % 2 == 0 else b).run(v), [0, 1, 2, 3]
+    )
+    assert not errors
+    assert results == ["a", "b", "a", "b"]
+
+
+def test_multiplex_lru_is_per_instance():
+    class R:
+        def __init__(self, tag):
+            self.tag = tag
+
+        @multiplexed(max_num_models_per_replica=1)
+        def get_model(self, model_id):
+            return f"{self.tag}:{model_id}"
+
+    r1, r2 = R("one"), R("two")
+    assert r1.get_model("m") == "one:m"
+    assert r2.get_model("m") == "two:m"  # not r1's cached model
