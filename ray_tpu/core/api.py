@@ -64,13 +64,27 @@ def init(
         if object_store_memory_mb is not None:
             config.set("object_store_memory_mb", object_store_memory_mb)
 
+        remote_driver = False
+        if address is not None and address.startswith("rt://"):
+            # remote-driver mode (reference ray:// client): everything
+            # rides the head gateway — the only address we can reach
+            from ray_tpu.utils import gateway as gateway_mod
+
+            gw_addr = address[len("rt://"):]
+            info = gateway_mod.fetch_info(gw_addr)
+            gateway_mod.set_gateway(gw_addr)
+            address = info["control_address"]
+            remote_driver = True
         if address is None:
             from ray_tpu.core.control_store import ControlStore
             from ray_tpu.core.node_agent import NodeAgent
+            from ray_tpu.utils.gateway import Gateway
 
             session_id = uuid.uuid4().hex
             control = ControlStore(session_id)
             control.start()
+            gateway_srv = Gateway(control.address)
+            gateway_srv.start()
             res_override: Dict[str, float] = dict(resources or {})
             if num_cpus is not None:
                 res_override["CPU"] = float(num_cpus)
@@ -81,7 +95,9 @@ def init(
                 resources=res_override or None, labels=labels,
             )
             agent.start()
-            _head_services = {"control": control, "agent": agent}
+            _head_services = {
+                "control": control, "agent": agent, "gateway": gateway_srv,
+            }
             control_address = control.address
             agent_address = agent.address
             node_id_hex = agent.node_id.hex()
@@ -108,6 +124,8 @@ def init(
             node_id_hex=node_id_hex,
         )
         w.namespace = namespace
+        if remote_driver:
+            w.enable_gateway_mode()
         w.connect_driver()
         worker_mod.set_global_worker(w)
         from ray_tpu import usage
@@ -130,7 +148,13 @@ def shutdown() -> None:
         if _head_services is not None:
             _head_services["agent"].stop()
             _head_services["control"].stop()
+            gw = _head_services.get("gateway")
+            if gw is not None:
+                gw.stop()
             _head_services = None
+        from ray_tpu.utils import gateway as gateway_mod
+
+        gateway_mod.set_gateway(None)
 
 
 def remote(*args, **options):

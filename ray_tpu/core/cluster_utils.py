@@ -96,6 +96,10 @@ class Cluster:
         self.session_id = uuid.uuid4().hex
         self.control = ControlStore(self.session_id)
         self.control.start()
+        from ray_tpu.utils.gateway import Gateway
+
+        self.gateway = Gateway(self.control.address)
+        self.gateway.start()
         self.nodes: List[ClusterNode] = []
 
     @property
@@ -181,6 +185,10 @@ class Cluster:
             client.close()
 
     def shutdown(self) -> None:
+        try:
+            self.gateway.stop()
+        except Exception:  # noqa: BLE001
+            pass
         for node in list(self.nodes):
             try:
                 os.killpg(os.getpgid(node.proc.pid), 15)

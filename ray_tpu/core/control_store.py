@@ -677,6 +677,7 @@ class ControlStore:
                 # actor leases are store-managed: a transient store->agent
                 # reconnect must not reap every actor on the node
                 bind_to_conn=False,
+                runtime_env=record.get("runtime_env"),
             )
         except RpcError as e:
             logger.warning(

@@ -42,19 +42,26 @@ logger = logging.getLogger(__name__)
 
 
 class _Worker:
-    __slots__ = ("worker_id", "address", "pid", "proc", "state", "lease_id", "kind")
+    __slots__ = ("worker_id", "address", "pid", "proc", "state", "lease_id",
+                 "kind", "env_hash")
 
-    def __init__(self, worker_id, address, pid, proc, kind="cpu"):
+    def __init__(self, worker_id, address, pid, proc, kind="cpu",
+                 env_hash=""):
         self.worker_id = worker_id
         self.address = address
         self.pid = pid
         self.proc = proc  # subprocess.Popen or None (external)
         self.state = "idle"  # idle | leased | dead
         self.lease_id: Optional[str] = None
-        self.kind = kind  # "cpu" | "tpu" — pool is keyed by kind, the way
-        # the reference keys its pool by language + runtime-env hash
-        # (worker_pool.h:280); TPU workers keep the accelerator runtime on
-        # their import path, CPU workers start ~6x faster without it.
+        self.kind = kind  # "cpu" | "tpu"
+        # Pool is keyed by (kind, env_hash), the way the reference keys
+        # its pool by language + runtime-env hash (worker_pool.h:280):
+        # repeated use of one runtime env lands on warm workers that
+        # already booted with it, and heterogeneous jobs never share a
+        # process. "" = the default (no-env) pool. TPU workers keep the
+        # accelerator runtime on their import path, CPU workers start
+        # ~6x faster without it.
+        self.env_hash = env_hash
 
 
 class NodeAgent:
@@ -416,7 +423,8 @@ class NodeAgent:
     # worker pool (reference C6)
     # ------------------------------------------------------------------
 
-    def _spawn_worker(self, kind: str = "cpu") -> None:
+    def _spawn_worker(self, kind: str = "cpu", env_spec=None,
+                      env_hash: str = "") -> None:
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env = dict(os.environ)
         pythonpath = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -431,17 +439,36 @@ class NodeAgent:
             env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = pythonpath
         env["RT_CONFIG_SNAPSHOT"] = config.snapshot()
+        python = sys.executable
+        if env_spec:
+            # boot the worker INSIDE its runtime env: pip envs get the
+            # env's interpreter; working_dir/py_modules/env_vars apply in
+            # worker_main before the worker registers (reference: the
+            # runtime-env agent prepares the env, then the pool forks the
+            # worker into it)
+            from ray_tpu.core import runtime_env as runtime_env_mod
+
+            if env_spec.get("pip"):
+                python = runtime_env_mod.ensure_pip_env(env_spec["pip"])
+            import base64
+
+            from ray_tpu.utils import serialization
+
+            env["RT_BOOT_ENV"] = base64.b64encode(
+                serialization.dumps(env_spec)
+            ).decode()
         log_base = os.path.join(self.temp_dir, "logs", f"worker-{uuid.uuid4().hex[:8]}")
         stdout = open(log_base + ".out", "wb")
         stderr = open(log_base + ".err", "wb")
         proc = subprocess.Popen(
             [
-                sys.executable, "-m", "ray_tpu.core.worker_main",
+                python, "-m", "ray_tpu.core.worker_main",
                 "--node-address", self.address,
                 "--control-address", self.control_address,
                 "--node-id", self.node_id.hex(),
                 "--session-id", self.session_id,
                 "--kind", kind,
+                "--env-hash", env_hash,
             ],
             env=env, stdout=stdout, stderr=stderr, start_new_session=True,
         )
@@ -492,11 +519,11 @@ class NodeAgent:
                 pass
 
     def rpc_register_worker(self, conn, worker_id: str, address: str, pid: int,
-                            kind: str = "cpu"):
+                            kind: str = "cpu", env_hash: str = ""):
         with self._lock:
             self._pending_spawns = max(0, self._pending_spawns - 1)
             w = _Worker(worker_id, address, pid, _PROC_REGISTRY.pop(pid, None),
-                        kind=kind)
+                        kind=kind, env_hash=env_hash)
             self._workers[worker_id] = w
             self._cv.notify_all()
         # a fresh idle worker unparks zero-wait lease retries just like
@@ -522,6 +549,7 @@ class NodeAgent:
         strategy=None,
         wait_s: float = 30.0,
         bind_to_conn: bool = True,
+        runtime_env=None,
     ):
         """bind_to_conn: a lease granted to a driver/executor (the lease
         cache) dies with its owner's RPC connection — an owner that exits
@@ -571,12 +599,16 @@ class NodeAgent:
         deadline = time.monotonic() + wait_s
         kind = "tpu" if resources.get("TPU") else "cpu"
         owner_conn = conn if (bind_to_conn and conn is not None) else None
+        from ray_tpu.core import runtime_env as runtime_env_mod
+
+        env_hash = runtime_env_mod.env_hash(runtime_env)
         return self._lease_wait(
-            resources, bundle, deadline, kind, strategy, owner_conn
+            resources, bundle, deadline, kind, strategy, owner_conn,
+            runtime_env, env_hash,
         )
 
     def _lease_wait(self, resources, bundle, deadline, kind, strategy=None,
-                    owner_conn=None):
+                    owner_conn=None, env_spec=None, env_hash=""):
         spawned_for_me = False
         starved = False  # counted toward autoscaler demand
         last_spill_check = time.monotonic()
@@ -608,7 +640,7 @@ class NodeAgent:
                         return {
                             "granted": False, "error": "owner disconnected",
                         }
-                    worker = self._pop_idle_worker_locked(kind)
+                    worker = self._pop_idle_worker_locked(kind, env_hash)
                     if worker is not None:
                         lease_id = uuid.uuid4().hex
                         worker.state = "leased"
@@ -647,17 +679,26 @@ class NodeAgent:
                             1 for w in self._workers.values()
                             if w.kind == kind and w.state != "dead"
                         )
+                        evicted = None
+                        if n_kind + self._pending_spawns >= cap:
+                            # at capacity with idle workers of another
+                            # runtime env: evict one to make room
+                            evicted = self._evict_idle_mismatch_locked(
+                                kind, env_hash
+                            )
                         # pending_spawns == 0 always allows a spawn: the
                         # demand DID fit the resources (ok was True), so
                         # zero/fractional-CPU requests past the capacity
                         # cap must still make progress — the cap only
                         # throttles CONCURRENT spawns from retry storms
-                        if self._pending_spawns == 0 or (
+                        if evicted is not None or self._pending_spawns == 0 or (
                             n_kind + self._pending_spawns < cap
                         ):
                             self._lock.release()
                             try:
-                                self._spawn_worker(kind)
+                                if evicted is not None:
+                                    self._terminate_worker(evicted)
+                                self._spawn_worker(kind, env_spec, env_hash)
                             finally:
                                 self._lock.acquire()
                 remaining = deadline - time.monotonic()
@@ -819,9 +860,29 @@ class NodeAgent:
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) + v
 
-    def _pop_idle_worker_locked(self, kind: str = "cpu") -> Optional[_Worker]:
+    def _pop_idle_worker_locked(self, kind: str = "cpu",
+                                env_hash: str = "") -> Optional[_Worker]:
         for w in self._workers.values():
-            if w.state == "idle" and w.kind == kind:
+            if (
+                w.state == "idle" and w.kind == kind
+                and w.env_hash == env_hash
+            ):
+                return w
+        return None
+
+    def _evict_idle_mismatch_locked(self, kind: str,
+                                    env_hash: str) -> Optional[_Worker]:
+        """An idle worker of the right kind but the WRONG runtime env:
+        evictable to make room under the kind capacity cap (reference:
+        the pool kills idle workers when a differently-env'd lease needs
+        the slot)."""
+        for w in self._workers.values():
+            if (
+                w.state == "idle" and w.kind == kind
+                and w.env_hash != env_hash
+            ):
+                self._workers.pop(w.worker_id, None)
+                w.state = "dead"
                 return w
         return None
 

@@ -1,14 +1,28 @@
-"""Runtime environments: per-task/actor env_vars and working_dir.
+"""Runtime environments: env_vars, working_dir, py_modules, pip.
 
 Parity: the reference runtime-env plugin system (C17/P9 —
-python/ray/_private/runtime_env/{working_dir,...}.py + the per-node
-agent's URI cache). Scope here is the two plugins everything else builds
-on: env_vars (set for the duration of the execution) and working_dir
-(the driver zips the directory into the control-store KV once,
-content-addressed; executors download/extract/cache it and run with it
-as cwd + on sys.path). pip/conda envs are out of scope in this
-no-network image — the by-value cloudpickle of user modules
-(utils/serialization.py) covers driver-local code instead.
+python/ray/_private/runtime_env/{working_dir,py_modules,pip,uv}.py + the
+per-node agent's URI cache). Plugins here:
+
+- env_vars: set for the duration of the execution (or the worker's life
+  for actors / env-booted workers);
+- working_dir: the driver zips the directory into the control-store KV
+  once (content-addressed); executors extract/cache and run with it as
+  cwd + on sys.path;
+- py_modules: like working_dir but each entry is one module/package
+  directory placed on sys.path (no chdir) — several jobs can ship
+  DIFFERENT versions of the same module name and stay isolated because
+  the worker pool is keyed by runtime-env hash;
+- pip: a venv (--system-site-packages, so ray_tpu and jax resolve from
+  the base image) with the requested packages installed OFFLINE from
+  the local wheel directories in ``config.pip_find_links`` (this image
+  has no egress — the reference's pip/uv plugin hits PyPI instead).
+  Workers for a pip env are spawned from the env's own interpreter.
+
+The node agent keys its worker pool by ``env_hash`` (reference
+worker_pool.h:280): repeated use of one env lands on warm, already-
+booted workers, and executions whose env matches the worker's boot env
+skip per-task apply entirely.
 """
 
 from __future__ import annotations
@@ -43,6 +57,61 @@ def _content_digest(blob: bytes) -> str:
     return hashlib.sha1(blob).hexdigest()
 
 
+def env_hash(runtime_env: Optional[Dict[str, Any]]) -> str:
+    """Stable identity of a PREPARED runtime env — the worker-pool key
+    (reference: runtime_env_hash on the lease spec, worker_pool.h:280).
+    Empty env hashes to "" (the default pool)."""
+    if not runtime_env:
+        return ""
+    import json
+
+    blob = json.dumps(runtime_env, sort_keys=True, default=str).encode()
+    return _content_digest(blob)
+
+
+def _zip_dir(path: str, arc_prefix: str = "") -> bytes:
+    """Deterministic zip of a directory (no timestamps — the digest must
+    be stable across re-zips of identical content)."""
+    buf = io.BytesIO()
+    total = 0
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(
+            d for d in dirs if d not in ("__pycache__", ".git")
+        )
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, path)
+            if arc_prefix:
+                rel = os.path.join(arc_prefix, rel)
+            entries.append((full, rel))
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for full, rel in entries:
+            total += os.path.getsize(full)
+            if total > _MAX_WORKING_DIR_BYTES:
+                raise ValueError(
+                    f"{path!r} exceeds {_MAX_WORKING_DIR_BYTES >> 20}MB"
+                )
+            info = zipfile.ZipInfo(rel)  # fixed (1980) timestamp
+            # a bare ZipInfo defaults to STORED and zero permissions:
+            # keep deflate and the file mode (an executable script must
+            # stay +x after extraction)
+            info.compress_type = zipfile.ZIP_DEFLATED
+            info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            with open(full, "rb") as f:
+                zf.writestr(info, f.read())
+    return buf.getvalue()
+
+
+def _upload_blob(blob: bytes, control) -> str:
+    digest = _content_digest(blob)
+    control.call(
+        "kv_put", ns=_KV_NS, key=digest, value=blob, overwrite=False,
+        retryable=True,
+    )
+    return digest
+
+
 def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str, Any]]:
     """Driver-side: normalize + upload. working_dir paths become
     content-addressed KV references, uploaded ONCE per directory path per
@@ -67,33 +136,124 @@ def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str
             return out
         if not os.path.isdir(wd):
             raise ValueError(f"working_dir {wd!r} is not a directory")
-        buf = io.BytesIO()
-        total = 0
-        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
-            for root, dirs, files in os.walk(wd):
-                dirs[:] = [d for d in dirs if d not in ("__pycache__", ".git")]
-                for name in files:
-                    path = os.path.join(root, name)
-                    total += os.path.getsize(path)
-                    if total > _MAX_WORKING_DIR_BYTES:
-                        raise ValueError(
-                            f"working_dir {wd!r} exceeds "
-                            f"{_MAX_WORKING_DIR_BYTES >> 20}MB"
-                        )
-                    zf.write(path, os.path.relpath(path, wd))
-        blob = buf.getvalue()
-        digest = _content_digest(blob)
-        control.call(
-            "kv_put", ns=_KV_NS, key=digest, value=blob, overwrite=False,
-            retryable=True,
-        )
+        digest = _upload_blob(_zip_dir(wd), control)
         with _cache_lock:
             _uploaded[wd] = digest
         out["working_dir"] = {"kv_key": digest}
+    mods = out.get("py_modules")
+    if mods:
+        prepared = []
+        for mod in mods:
+            if isinstance(mod, dict):
+                prepared.append(mod)  # already uploaded
+                continue
+            mod = os.path.abspath(mod)
+            with _cache_lock:
+                digest = _uploaded.get(mod)
+            if digest is None:
+                if not os.path.isdir(mod):
+                    raise ValueError(
+                        f"py_modules entry {mod!r} is not a directory"
+                    )
+                # zip UNDER the package name so extraction yields an
+                # importable <name>/ on sys.path
+                digest = _upload_blob(
+                    _zip_dir(mod, arc_prefix=os.path.basename(mod)),
+                    control,
+                )
+                with _cache_lock:
+                    _uploaded[mod] = digest
+            prepared.append(
+                {"kv_key": digest, "name": os.path.basename(mod)}
+            )
+        out["py_modules"] = prepared
+    pip = out.get("pip")
+    if pip:
+        if isinstance(pip, dict):
+            pip = pip.get("packages", [])
+        out["pip"] = sorted(str(p) for p in pip)
     env_vars = out.get("env_vars")
     if env_vars is not None:
         out["env_vars"] = {str(k): str(v) for k, v in env_vars.items()}
     return out
+
+
+def ensure_pip_env(packages) -> str:
+    """Node-side: create (or reuse) a venv with ``packages`` installed
+    from the local wheel dirs, returning its python executable. Offline
+    by design: ``--no-index --find-links <config.pip_find_links>`` (this
+    image has no egress; the reference pip/uv plugin would hit an index).
+    Content-addressed by the sorted package list; creation is
+    single-flight per env across threads (a marker file makes it
+    idempotent across processes on one host)."""
+    import json
+    import subprocess
+    import sys as sys_mod
+
+    from ray_tpu.utils.config import config
+
+    packages = sorted(str(p) for p in packages)
+    key = _content_digest(json.dumps(packages).encode())[:16]
+    env_dir = os.path.join("/tmp/ray_tpu/pip_envs", key)
+    python = os.path.join(env_dir, "bin", "python")
+    marker = os.path.join(env_dir, ".rt_ready")
+    with _pip_lock:
+        if os.path.exists(marker):
+            return python
+        tmp = env_dir + f".tmp{os.getpid()}"
+        import venv
+
+        venv.EnvBuilder(
+            system_site_packages=True, with_pip=True, symlinks=True
+        ).create(tmp)
+        # venv-from-venv: --system-site-packages exposes the BASE
+        # interpreter's site dirs, not this (already-virtual) parent's —
+        # bridge the parent's site-packages with a .pth so ray_tpu's own
+        # dependencies (cloudpickle, numpy, jax) stay importable
+        import site
+
+        parent_sites = [
+            p for p in site.getsitepackages() + sys_mod.path
+            if p.endswith("site-packages") and os.path.isdir(p)
+        ]
+        lib = os.path.join(tmp, "lib")
+        (pydir,) = [d for d in os.listdir(lib) if d.startswith("python")]
+        pth = os.path.join(lib, pydir, "site-packages", "rt_parent.pth")
+        with open(pth, "w") as f:
+            f.write("\n".join(dict.fromkeys(parent_sites)) + "\n")
+        find_links = [
+            d for d in str(config.pip_find_links).split(os.pathsep) if d
+        ]
+        cmd = [
+            os.path.join(tmp, "bin", "python"), "-m", "pip", "install",
+            "--quiet", "--no-index",
+        ]
+        for d in find_links:
+            cmd += ["--find-links", d]
+        cmd += packages
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True,
+                timeout=600,
+            )
+        except subprocess.CalledProcessError as e:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"pip env creation failed for {packages}: {e.stderr[-2000:]}"
+            ) from None
+        try:
+            os.rename(tmp, env_dir)
+        except OSError:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)  # lost a cross-process race
+        open(marker, "w").close()
+        return python
+
+
+_pip_lock = threading.Lock()
 
 
 def _fetch_working_dir(digest: str, control) -> str:
@@ -121,14 +281,46 @@ def _fetch_working_dir(digest: str, control) -> str:
     return target
 
 
+def _pip_site_dir(packages) -> str:
+    """The env's site-packages dir (creating the env if needed)."""
+    python = ensure_pip_env(packages)
+    env_dir = os.path.dirname(os.path.dirname(python))
+    lib = os.path.join(env_dir, "lib")
+    (pydir,) = [d for d in os.listdir(lib) if d.startswith("python")]
+    return os.path.join(lib, pydir, "site-packages")
+
+
+def _in_pip_env(packages) -> bool:
+    """True when THIS interpreter already is the env's python (the
+    worker was spawned from it — the env-keyed pool's normal case)."""
+    import json
+
+    key = _content_digest(
+        json.dumps(sorted(str(p) for p in packages)).encode()
+    )[:16]
+    return os.path.basename(sys.prefix) == key
+
+
 def apply_permanent(runtime_env: Optional[Dict[str, Any]], control) -> None:
-    """Executor-side, for actors: the worker process is dedicated to one
-    actor, so its runtime env applies for the process's whole life (no
+    """Executor-side, for actors and env-booted workers: the process is
+    dedicated to one env, so it applies for the process's whole life (no
     restore). Same semantics as one `apply` entered forever."""
     if not runtime_env:
         return
     for k, v in (runtime_env.get("env_vars") or {}).items():
         os.environ[k] = v
+    for mod in runtime_env.get("py_modules") or []:
+        if isinstance(mod, dict) and "kv_key" in mod:
+            path = _fetch_working_dir(mod["kv_key"], control)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+    pip = runtime_env.get("pip")
+    if pip and not _in_pip_env(pip):
+        # fallback for a worker NOT spawned from the env python (pool
+        # miss): pure-python packages resolve via the env's site dir
+        import site
+
+        site.addsitedir(_pip_site_dir(pip))
     wd = runtime_env.get("working_dir")
     if isinstance(wd, dict) and "kv_key" in wd:
         path = _fetch_working_dir(wd["kv_key"], control)
@@ -150,11 +342,23 @@ def apply(runtime_env: Optional[Dict[str, Any]], control):
         return
     saved_env: Dict[str, Optional[str]] = {}
     saved_cwd = None
-    added_path = None
+    added_paths = []
     try:
         for k, v in (runtime_env.get("env_vars") or {}).items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = v
+        for mod in runtime_env.get("py_modules") or []:
+            if isinstance(mod, dict) and "kv_key" in mod:
+                path = _fetch_working_dir(mod["kv_key"], control)
+                if path not in sys.path:
+                    sys.path.insert(0, path)
+                    added_paths.append(path)
+        pip = runtime_env.get("pip")
+        if pip and not _in_pip_env(pip):
+            site_dir = _pip_site_dir(pip)
+            if site_dir not in sys.path:
+                sys.path.insert(0, site_dir)
+                added_paths.append(site_dir)
         wd = runtime_env.get("working_dir")
         if isinstance(wd, dict) and "kv_key" in wd:
             path = _fetch_working_dir(wd["kv_key"], control)
@@ -162,7 +366,7 @@ def apply(runtime_env: Optional[Dict[str, Any]], control):
             os.chdir(path)
             if path not in sys.path:
                 sys.path.insert(0, path)
-                added_path = path
+                added_paths.append(path)
         yield
     finally:
         if saved_cwd is not None:
@@ -170,9 +374,9 @@ def apply(runtime_env: Optional[Dict[str, Any]], control):
                 os.chdir(saved_cwd)
             except OSError:
                 pass
-        if added_path is not None:
+        for path in added_paths:
             try:
-                sys.path.remove(added_path)
+                sys.path.remove(path)
             except ValueError:
                 pass
         for k, v in saved_env.items():

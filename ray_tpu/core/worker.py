@@ -364,6 +364,10 @@ class CoreWorker:
         # over the sendfile data plane (cross host)
         self._device_exports: Dict[str, Dict[str, Any]] = {}
         self._device_exports_lock = threading.Lock()
+        # remote-driver (gateway) mode: set by enable_gateway_mode()
+        self._public_address: Optional[str] = None
+        self._remote_driver = False
+        self._reverse_listener = None
         self.reference_tracker = ReferenceTracker(self)
 
         self.job_id = job_id or JobID.nil()
@@ -437,6 +441,10 @@ class CoreWorker:
 
     @property
     def address(self) -> str:
+        # remote-driver mode: advertise the gateway-side reverse address
+        # (cluster peers cannot reach a NAT'd driver directly)
+        if self._public_address is not None:
+            return self._public_address
         return self.server.address
 
     def owns(self, ref: ObjectRef) -> bool:
@@ -494,6 +502,23 @@ class CoreWorker:
         self.control.on_push("pubsub", on_pubsub)
         self.control.call("subscribe", topics=["actor"], retryable=True)
 
+    def enable_gateway_mode(self) -> None:
+        """Remote-driver mode (reference ray:// client,
+        util/client/ARCHITECTURE.md): this driver reaches the cluster
+        only through the head gateway. Outbound connections tunnel
+        (rpc.py connect); inbound peers reach us via a gateway-side
+        reverse bind whose address we advertise; and shm paths are never
+        local, so big objects stay in the memory store and plasma reads
+        always take the chunked/data-plane pull."""
+        from ray_tpu.utils import gateway as gateway_mod
+
+        self._remote_driver = True
+        rl = gateway_mod.ReverseListener(
+            self.server, f"drv-{self.worker_id.hex()[:12]}"
+        )
+        self._public_address = rl.start()
+        self._reverse_listener = rl
+
     def connect_worker(self) -> None:
         self.agent.call(
             "register_worker",
@@ -501,6 +526,7 @@ class CoreWorker:
             address=self.address,
             pid=os.getpid(),
             kind=getattr(self, "worker_kind", "cpu"),
+            env_hash=getattr(self, "boot_env_hash", ""),
             retryable=True,
         )
         self._subscribe_actor_updates()
@@ -526,6 +552,11 @@ class CoreWorker:
                 os._exit(1)
 
     def shutdown(self) -> None:
+        if self._reverse_listener is not None:
+            try:
+                self._reverse_listener.stop()
+            except Exception:  # noqa: BLE001 — teardown path
+                pass
         self._shutdown.set()
         self._submit_pool.shutdown(wait=False)
         self.server.stop()
@@ -617,7 +648,10 @@ class CoreWorker:
             raise ObjectLostError(
                 f"device object {dv.obj_hex[:16]} was freed at the holder"
             )
-        if meta["agent_addr"] == self.node_agent_address:
+        if (
+            meta["agent_addr"] == self.node_agent_address
+            and not self._remote_driver
+        ):
             # drop any cached mmap of this path first: a retried task can
             # re-export under the same deterministic object id, and a
             # stale mapping of the deleted inode would silently serve the
@@ -646,6 +680,11 @@ class CoreWorker:
         return dev_mod.join_device_value(dv.skeleton, arrays)
 
     def _store_frame_maybe_plasma(self, oid: ObjectID, frame: bytes) -> None:
+        if self._remote_driver:
+            # no local shm on a gateway driver: keep the frame owner-side;
+            # consumers fetch via get_object (chunked over the tunnel)
+            self.memory_store.put(oid, frame)
+            return
         if len(frame) > config.max_direct_call_object_size:
             path = self.agent.call("create_object", oid_hex=oid.hex(), size=len(frame))
             self.shm.write(path, frame)
@@ -691,7 +730,10 @@ class CoreWorker:
             try:
                 reply = client.call(
                     "get_object", oid_hex=ref.id.hex(), wait_s=timeout_s,
-                    requester_agent=self.node_agent_address,
+                    requester_agent=(
+                        "remote-driver" if self._remote_driver
+                        else self.node_agent_address
+                    ),
                     timeout_s=(timeout_s + 30.0) if timeout_s is not None else 86400.0,
                 )
             except RpcTimeout:
@@ -728,7 +770,10 @@ class CoreWorker:
         if isinstance(stored, (bytes, bytearray, memoryview)):
             return serialization.unpack(stored)
         if isinstance(stored, PlasmaValue):
-            if stored.agent_address != self.node_agent_address:
+            if (
+                stored.agent_address != self.node_agent_address
+                or self._remote_driver
+            ):
                 # Owner-side ref to a segment hosted on another node (the
                 # producing task ran remotely): pull through that node's
                 # agent rather than touching a path that only exists there.
@@ -883,8 +928,18 @@ class CoreWorker:
         if not port:
             return False
         host = agent_address.rsplit(":", 1)[0]
+        from ray_tpu.utils import gateway as gateway_mod
+
+        def _open_data_conn():
+            if gateway_mod.gateway_address() is not None:
+                # remote-driver mode: the raw data plane tunnels too
+                return gateway_mod.open_tunnel(
+                    f"{host}:{port}", timeout=5.0
+                )
+            return socket.create_connection((host, port), timeout=5.0)
+
         try:
-            with socket.create_connection((host, port), timeout=5.0) as s:
+            with _open_data_conn() as s:
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # kernel-level receive timeout: the native pump blocks in
                 # recv(2) without Python's non-blocking timeout machinery
@@ -1226,7 +1281,9 @@ class CoreWorker:
             with self._task_submitters_lock:
                 sub = self._task_submitters.get(key)
                 if sub is None:
-                    sub = _NormalTaskSubmitter(self, spec.resources, strategy)
+                    sub = _NormalTaskSubmitter(
+                        self, spec.resources, strategy, spec.runtime_env
+                    )
                     self._task_submitters[key] = sub
                     if self._submitter_janitor is None:
                         self._submitter_janitor = threading.Thread(
@@ -1491,6 +1548,7 @@ class CoreWorker:
                 strategy=strategy,
                 wait_s=30.0,
                 timeout_s=45.0,
+                runtime_env=spec.runtime_env,
             )
             if lease.get("granted"):
                 break
@@ -2070,8 +2128,16 @@ class CoreWorker:
             args, kwargs = serialization.unpack(spec.args_frame)
             args = [self._resolve_arg(a) for a in args]
             kwargs = {k: self._resolve_arg(v) for k, v in kwargs.items()}
-            with runtime_env_mod.apply(spec.runtime_env, self.control):
+            if spec.runtime_env and spec.runtime_env == getattr(
+                self, "boot_env_spec", None
+            ):
+                # env-keyed pool hit: this worker BOOTED inside the env
+                # (worker_main applied it permanently) — skip per-task
+                # setup entirely (reference: env-hash worker binning)
                 result = target(*args, **kwargs)
+            else:
+                with runtime_env_mod.apply(spec.runtime_env, self.control):
+                    result = target(*args, **kwargs)
             returns = self._package_returns(spec, result)
             return {"status": "ok", "returns": returns}
         except TaskError as e:
@@ -2665,10 +2731,11 @@ class _NormalTaskSubmitter:
     """
 
     def __init__(self, worker: CoreWorker, resources: Dict[str, float],
-                 strategy):
+                 strategy, runtime_env=None):
         self.w = worker
         self.resources = dict(resources)
         self.strategy = strategy
+        self.runtime_env = runtime_env
         self.lock = threading.Lock()
         self.pending: deque = deque()
         self.idle: List[_Lease] = []
@@ -3139,6 +3206,7 @@ class _NormalTaskSubmitter:
                         strategy=strategy,
                         wait_s=5.0,
                         timeout_s=20.0,
+                        runtime_env=self.runtime_env,
                     )
                 except (RpcConnectionError, RpcTimeout) as e:
                     if isinstance(e, RpcConnectionError):

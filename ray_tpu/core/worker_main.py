@@ -21,6 +21,7 @@ def main() -> None:
     parser.add_argument("--node-id", required=True)
     parser.add_argument("--session-id", required=True)
     parser.add_argument("--kind", default="cpu")
+    parser.add_argument("--env-hash", default="")
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -44,6 +45,20 @@ def main() -> None:
         node_id_hex=args.node_id,
     )
     w.worker_kind = args.kind
+    w.boot_env_hash = args.env_hash
+    boot_env = os.environ.get("RT_BOOT_ENV")
+    if boot_env:
+        # env-keyed pool: this worker is dedicated to one runtime env —
+        # apply it for the process's whole life BEFORE registering, so a
+        # lease granted against our env_hash lands on a ready worker
+        import base64
+
+        from ray_tpu.core import runtime_env as runtime_env_mod
+        from ray_tpu.utils import serialization
+
+        spec = serialization.loads(base64.b64decode(boot_env))
+        runtime_env_mod.apply_permanent(spec, w.control)
+        w.boot_env_spec = spec
     worker_mod.set_global_worker(w)
     w.connect_worker()
 

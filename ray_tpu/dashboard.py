@@ -67,17 +67,45 @@ class Dashboard:
             def log_message(self, fmt, *args):
                 pass
 
+            def _respond(self, status, ctype, body):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 try:
                     status, ctype, body = dash._route(self.path)
                 except Exception as e:  # noqa: BLE001
                     status, ctype = 500, "application/json"
                     body = json.dumps({"error": str(e)}).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._respond(status, ctype, body)
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    payload = self.rfile.read(length) if length else b""
+                    status, ctype, body = dash._route_post(
+                        self.path, payload
+                    )
+                except Exception as e:  # noqa: BLE001
+                    status, ctype = 500, "application/json"
+                    body = json.dumps({"error": str(e)}).encode()
+                self._respond(status, ctype, body)
+
+            def do_DELETE(self):
+                # DELETE /api/jobs/<sid> deletes a terminal job's record
+                # (reference job API; stopping a running job is POST
+                # .../stop)
+                try:
+                    status, ctype, body = dash._route_post(
+                        self.path.rstrip("/") + "/delete", b""
+                    )
+                except Exception as e:  # noqa: BLE001
+                    status, ctype = 500, "application/json"
+                    body = json.dumps({"error": str(e)}).encode()
+                self._respond(status, ctype, body)
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
@@ -117,6 +145,8 @@ class Dashboard:
                 200, "application/json",
                 json.dumps(apis[path](), default=str).encode(),
             )
+        if path.startswith("/api/jobs/"):
+            return self._route_job_get(path)
         if path == "/metrics":
             text = metrics_mod.prometheus_text(state.cluster_metrics(addr))
             return 200, "text/plain; version=0.0.4", text.encode()
@@ -138,6 +168,75 @@ class Dashboard:
                 ),
             )
             return 200, "text/html", page.encode()
+        return 404, "application/json", b'{"error": "not found"}'
+
+    # -- job submission REST API (reference dashboard job module:
+    # python/ray/dashboard/modules/job/job_manager.py:62 — submit/
+    # status/logs/stop over HTTP, so `curl` and CI drive jobs with no
+    # in-process client) -----------------------------------------------
+
+    def _job_client(self):
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        if getattr(self, "_jobs_client", None) is None:
+            self._jobs_client = JobSubmissionClient()
+        return self._jobs_client
+
+    def _route_job_get(self, path: str):
+        parts = [p for p in path.split("/") if p]  # api, jobs, sid, [sub]
+        client = self._job_client()
+        if parts == ["api", "jobs", "submissions"]:
+            return (
+                200, "application/json",
+                json.dumps(client.list_jobs(), default=str).encode(),
+            )
+        sid = parts[2]
+        if len(parts) == 3:
+            return (
+                200, "application/json",
+                json.dumps(client.get_job_info(sid), default=str).encode(),
+            )
+        if len(parts) == 4 and parts[3] == "logs":
+            return (
+                200, "application/json",
+                json.dumps({"logs": client.get_job_logs(sid)}).encode(),
+            )
+        return 404, "application/json", b'{"error": "not found"}'
+
+    def _route_post(self, path: str, payload: bytes):
+        parts = [p for p in path.split("/") if p]
+        if parts[:2] != ["api", "jobs"]:
+            return 404, "application/json", b'{"error": "not found"}'
+        client = self._job_client()
+        if len(parts) == 2:  # POST /api/jobs — submit
+            body = json.loads(payload or b"{}")
+            entrypoint = body.get("entrypoint")
+            if not entrypoint:
+                return (
+                    400, "application/json",
+                    b'{"error": "entrypoint required"}',
+                )
+            sid = client.submit_job(
+                entrypoint=entrypoint,
+                submission_id=body.get("submission_id"),
+                runtime_env=body.get("runtime_env"),
+            )
+            return (
+                200, "application/json",
+                json.dumps({"submission_id": sid}).encode(),
+            )
+        if len(parts) == 4 and parts[3] == "stop":
+            stopped = client.stop_job(parts[2])
+            return (
+                200, "application/json",
+                json.dumps({"stopped": bool(stopped)}).encode(),
+            )
+        if len(parts) == 4 and parts[3] == "delete":
+            deleted = client.delete_job(parts[2])
+            return (
+                200, "application/json",
+                json.dumps({"deleted": bool(deleted)}).encode(),
+            )
         return 404, "application/json", b'{"error": "not found"}'
 
 

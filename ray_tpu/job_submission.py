@@ -163,6 +163,26 @@ class JobSubmissionClient:
             self._sup(submission_id).stop.remote(), timeout=30
         )
 
+    def delete_job(self, submission_id: str) -> bool:
+        """Remove a finished submission: kill its (detached) supervisor —
+        freeing the CPU it holds for status/logs serving — and drop the
+        registry entry (reference JobSubmissionClient.delete_job)."""
+        status = self.get_job_status(submission_id)
+        if status == JobStatus.RUNNING:
+            raise RuntimeError(
+                f"job {submission_id} is RUNNING; stop it first"
+            )
+        try:
+            ray_tpu.kill(self._sup(submission_id))
+        except Exception:  # noqa: BLE001 — already gone
+            pass
+        from ray_tpu.core import worker as worker_mod
+
+        worker_mod.global_worker().control.call(
+            "kv_del", ns="job_submissions", key=submission_id,
+        )
+        return True
+
     def list_jobs(self) -> List[Dict[str, Any]]:
         from ray_tpu.core import worker as worker_mod
 

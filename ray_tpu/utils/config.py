@@ -134,6 +134,9 @@ config.define("max_lease_requests_per_key", 10)
 # Short tasks pipeline onto few warm workers (a worker process per nop
 # task is pure context-switch overhead); long tasks scale wide.
 config.define("lease_rampup_target_s", 0.1)
+# pip runtime envs install OFFLINE from these local wheel directories
+# (os.pathsep-separated; this image has no egress to an index)
+config.define("pip_find_links", "/tmp/ray_tpu/wheels")
 # Owner-side lineage entries kept for object reconstruction (reference
 # bounds lineage by bytes; we bound by task count).
 config.define("lineage_max_entries", 10000)

@@ -1,0 +1,378 @@
+"""Single-endpoint driver gateway — the ``ray://`` client equivalent.
+
+Parity: the reference's remote-driver proxy
+(python/ray/util/client/ARCHITECTURE.md, util/client/worker.py:1): a
+driver that can reach ONLY the head node's gateway port gets full
+cluster access. Design here is a TCP-splicing gateway rather than a
+gRPC re-encoding proxy — every existing protocol (framed RPC, the raw
+sendfile data plane) rides through unchanged:
+
+- **forward tunnels**: the driver's RpcClients and data-plane pulls
+  connect to the gateway and name their real target in one header
+  frame; the gateway dials the target and splices bytes both ways.
+- **reverse binds**: cluster peers must also reach the DRIVER (its
+  owner services: get_object, stream pushes, borrow callbacks). The
+  driver asks the gateway to listen on a head-side port on its behalf
+  and parks pre-opened *anchor* connections; each inbound peer
+  connection is paired with an anchor and spliced, and the driver
+  adopts the anchor socket into its RpcServer. The address the driver
+  advertises in specs/refs is the gateway-side one, so NAT in front of
+  the driver never matters.
+
+Header frames use the rpc module's [8-byte LE length][pickle] framing:
+    ("tunnel", "host:port")   -> ("ok",) then raw splice
+    ("info",)                 -> {"control_address": ...}, then close
+    ("reverse_bind", bind_id) -> ("ok", "host:port"), then close
+    ("anchor", bind_id)       -> parks; ("go",) when a peer arrives,
+                                 then raw splice
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+from ray_tpu.utils import serialization
+
+logger = logging.getLogger(__name__)
+
+# wire framing: REUSED from the rpc module (one definition of the
+# [8-byte LE length][payload] format in the codebase)
+from ray_tpu.utils.rpc import _LEN, _recv_exact  # noqa: E402
+
+# driver-side process-global: when set, every RpcClient / data-plane
+# connection is tunneled through this gateway address
+_gateway_addr: Optional[str] = None
+
+
+def set_gateway(addr: Optional[str]) -> None:
+    global _gateway_addr
+    _gateway_addr = addr
+
+
+def gateway_address() -> Optional[str]:
+    return _gateway_addr
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, 8))
+    return serialization.loads(_recv_exact(sock, n))
+
+
+def _dial(addr: str, timeout: float = 10.0) -> socket.socket:
+    host, port = addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    return sock
+
+
+def open_tunnel(target: str, timeout: float = 10.0) -> socket.socket:
+    """Driver-side: a socket that behaves like a direct connection to
+    ``target``, spliced through the configured gateway."""
+    assert _gateway_addr is not None
+    sock = _dial(_gateway_addr, timeout)
+    _send_frame(sock, serialization.dumps(("tunnel", target)))
+    reply = _recv_frame(sock)
+    if reply[0] != "ok":
+        sock.close()
+        raise ConnectionError(f"gateway refused tunnel to {target}: {reply}")
+    return sock
+
+
+def fetch_info(gateway: str) -> dict:
+    sock = _dial(gateway)
+    try:
+        _send_frame(sock, serialization.dumps(("info",)))
+        return _recv_frame(sock)
+    finally:
+        sock.close()
+
+
+def _splice(a: socket.socket, b: socket.socket) -> None:
+    """Copy a->b until EOF, then shut both down (the b->a direction runs
+    on its own thread doing the mirror image)."""
+    try:
+        while True:
+            data = a.recv(1 << 16)
+            if not data:
+                break
+            b.sendall(data)
+    except OSError:
+        pass
+    finally:
+        for s in (a, b):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+
+def _splice_pair(a: socket.socket, b: socket.socket) -> None:
+    t = threading.Thread(target=_splice, args=(b, a), daemon=True,
+                         name="gw-splice")
+    t.start()
+    _splice(a, b)
+    t.join()
+    for s in (a, b):
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
+class _ReverseBind:
+    def __init__(self, listener: socket.socket, port: int):
+        self.listener = listener
+        self.port = port
+        self.anchors: deque = deque()
+        self.cv = threading.Condition()
+
+
+class Gateway:
+    """Head-side gateway daemon. One per cluster, colocated with the
+    control store."""
+
+    def __init__(self, control_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        # loopback by default, like every other listener in the codebase:
+        # the tunnel op dials arbitrary client-named targets, so exposing
+        # it beyond the host (host="0.0.0.0") is an explicit deployment
+        # opt-in, made alongside whatever network policy guards the head
+        self.control_address = control_address
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+        self._binds: Dict[str, _ReverseBind] = {}
+        self._lock = threading.Lock()
+        self._stopped = threading.Event()
+
+    @property
+    def address(self) -> str:
+        host = self._listener.getsockname()[0]
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        return f"{host}:{self.port}"
+
+    def start(self) -> None:
+        threading.Thread(
+            target=self._accept_loop, name="gateway-accept", daemon=True
+        ).start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            binds = list(self._binds.values())
+            self._binds.clear()
+        for b in binds:
+            try:
+                b.listener.close()
+            except OSError:
+                pass
+
+    # -- gateway-port connections ---------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve, args=(sock,), name="gw-conn",
+                daemon=True,
+            ).start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        try:
+            msg = _recv_frame(sock)
+        except (ConnectionError, OSError):
+            sock.close()
+            return
+        kind = msg[0]
+        try:
+            if kind == "tunnel":
+                try:
+                    target = _dial(msg[1])
+                except OSError as e:
+                    _send_frame(sock, serialization.dumps(("error", str(e))))
+                    sock.close()
+                    return
+                _send_frame(sock, serialization.dumps(("ok",)))
+                _splice_pair(sock, target)
+            elif kind == "info":
+                _send_frame(
+                    sock,
+                    serialization.dumps(
+                        {"control_address": self.control_address}
+                    ),
+                )
+                sock.close()
+            elif kind == "reverse_bind":
+                addr = self._ensure_bind(msg[1])
+                _send_frame(sock, serialization.dumps(("ok", addr)))
+                sock.close()
+            elif kind == "anchor":
+                self._park_anchor(msg[1], sock)
+            else:
+                sock.close()
+        except (ConnectionError, OSError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- reverse binds --------------------------------------------------
+
+    def _ensure_bind(self, bind_id: str) -> str:
+        with self._lock:
+            bind = self._binds.get(bind_id)
+            if bind is None:
+                listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                listener.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+                )
+                listener.bind((self._listener.getsockname()[0], 0))
+                listener.listen(64)
+                bind = _ReverseBind(listener, listener.getsockname()[1])
+                self._binds[bind_id] = bind
+                threading.Thread(
+                    target=self._bind_accept_loop, args=(bind,),
+                    name="gw-rev-accept", daemon=True,
+                ).start()
+        host = self.address.rsplit(":", 1)[0]
+        return f"{host}:{bind.port}"
+
+    def _park_anchor(self, bind_id: str, sock: socket.socket) -> None:
+        addr = self._ensure_bind(bind_id)  # idempotent
+        bind = self._binds.get(bind_id)
+        if bind is None:
+            sock.close()
+            return
+        with bind.cv:
+            bind.anchors.append(sock)
+            bind.cv.notify_all()
+        del addr
+
+    def _bind_accept_loop(self, bind: _ReverseBind) -> None:
+        while not self._stopped.is_set():
+            try:
+                peer, _ = bind.listener.accept()
+            except OSError:
+                return
+            peer.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._pair, args=(bind, peer), name="gw-pair",
+                daemon=True,
+            ).start()
+
+    def _pair(self, bind: _ReverseBind, peer: socket.socket) -> None:
+        deadline = 30.0
+        with bind.cv:
+            while not bind.anchors:
+                if not bind.cv.wait(timeout=deadline):
+                    peer.close()
+                    return
+            anchor = bind.anchors.popleft()
+        try:
+            _send_frame(anchor, serialization.dumps(("go",)))
+        except OSError:
+            peer.close()
+            return
+        _splice_pair(anchor, peer)
+
+
+class ReverseListener:
+    """Driver-side: keeps anchors parked at the gateway and adopts each
+    paired connection into the local RpcServer."""
+
+    def __init__(self, server, bind_id: str, n_anchors: int = 8):
+        self.server = server
+        self.bind_id = bind_id
+        self.n_anchors = n_anchors
+        self.public_address: Optional[str] = None
+        self._stopped = threading.Event()
+        self._anchors_lock = threading.Lock()
+        self._open_anchors: set = set()
+
+    def start(self) -> str:
+        sock = _dial(_gateway_addr)
+        try:
+            _send_frame(
+                sock, serialization.dumps(("reverse_bind", self.bind_id))
+            )
+            reply = _recv_frame(sock)
+        finally:
+            sock.close()
+        if reply[0] != "ok":
+            raise ConnectionError(f"reverse bind failed: {reply}")
+        self.public_address = reply[1]
+        for _ in range(self.n_anchors):
+            self._launch_anchor()
+        return self.public_address
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._anchors_lock:
+            anchors, self._open_anchors = self._open_anchors, set()
+        for sock in anchors:
+            try:
+                sock.close()  # unblocks the parked _recv_frame
+            except OSError:
+                pass
+
+    def _launch_anchor(self) -> None:
+        threading.Thread(
+            target=self._anchor_loop, name="gw-anchor", daemon=True
+        ).start()
+
+    def _anchor_loop(self) -> None:
+        while not self._stopped.is_set():
+            gw = _gateway_addr
+            if gw is None:
+                return  # shutdown reset the gateway address
+            sock = None
+            try:
+                sock = _dial(gw)
+                with self._anchors_lock:
+                    self._open_anchors.add(sock)
+                _send_frame(
+                    sock, serialization.dumps(("anchor", self.bind_id))
+                )
+                msg = _recv_frame(sock)  # blocks until a peer arrives
+                if msg[0] != "go":
+                    sock.close()
+                    continue
+            except (ConnectionError, OSError):
+                if sock is not None:
+                    with self._anchors_lock:
+                        self._open_anchors.discard(sock)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                if self._stopped.wait(1.0):
+                    return
+                continue
+            with self._anchors_lock:
+                self._open_anchors.discard(sock)
+            # replace ourselves BEFORE serving: the pool of parked
+            # anchors must stay full while this one carries traffic
+            self._launch_anchor()
+            self.server.adopt(sock, ("gateway", 0))
+            return

@@ -233,6 +233,19 @@ class RpcServer:
                 name=f"{self.name}-conn", daemon=True,
             ).start()
 
+    def adopt(self, sock: socket.socket, addr) -> None:
+        """Serve a pre-connected socket as if it had arrived via accept()
+        — the driver-gateway reverse tunnel hands sockets in this way
+        (utils/gateway.py ReverseListener)."""
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = ClientConnection(sock, addr)
+        with self._conns_lock:
+            self._conns[id(conn)] = conn
+        threading.Thread(
+            target=self._serve_conn, args=(conn,),
+            name=f"{self.name}-conn", daemon=True,
+        ).start()
+
     def _serve_conn(self, conn: ClientConnection) -> None:
         try:
             while not self._stopped.is_set():
@@ -329,11 +342,23 @@ class RpcClient:
                 return
             deadline = time.monotonic() + config.rpc_connect_timeout_s
             last_err: Optional[Exception] = None
+            from ray_tpu.utils import gateway as gateway_mod
+
+            gw = gateway_mod.gateway_address()
             while time.monotonic() < deadline:
                 try:
-                    sock = socket.create_connection(
-                        (self._host, self._port), timeout=config.rpc_connect_timeout_s
-                    )
+                    if gw is not None and self.address != gw:
+                        # remote-driver mode: every connection rides the
+                        # head gateway (utils/gateway.py)
+                        sock = gateway_mod.open_tunnel(
+                            self.address,
+                            timeout=config.rpc_connect_timeout_s,
+                        )
+                    else:
+                        sock = socket.create_connection(
+                            (self._host, self._port),
+                            timeout=config.rpc_connect_timeout_s,
+                        )
                     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     sock.settimeout(None)
                     self._sock = sock

@@ -61,3 +61,61 @@ def test_job_list(client):
     jobs = client.list_jobs()
     assert len(jobs) >= 4
     assert all("submission_id" in j for j in jobs)
+
+
+def test_job_rest_api(client):
+    """HTTP job API on the dashboard port (reference
+    dashboard/modules/job/job_manager.py:62): submit/status/logs/stop via
+    plain HTTP — what `curl` or CI would drive."""
+    import json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard()
+    try:
+        base = f"http://{dash.address}"
+
+        def call(path, payload=None, method=None):
+            data = json.dumps(payload).encode() if payload is not None else None
+            req = urllib.request.Request(base + path, data=data, method=method)
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        # free the CPUs held by earlier tests' finished-job supervisors
+        for j in call("/api/jobs/submissions"):
+            if j.get("status") in ("SUCCEEDED", "FAILED", "STOPPED"):
+                call(f"/api/jobs/{j['submission_id']}/delete", method="POST")
+
+        sid = call("/api/jobs", {"entrypoint": "python -c \"print('rest-ok')\""})[
+            "submission_id"
+        ]
+        deadline = time.monotonic() + 120
+        while True:
+            info = call(f"/api/jobs/{sid}")
+            if info["status"] in ("SUCCEEDED", "FAILED", "STOPPED"):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.5)
+        assert info["status"] == "SUCCEEDED"
+        assert "rest-ok" in call(f"/api/jobs/{sid}/logs")["logs"]
+        subs = call("/api/jobs/submissions")
+        assert any(j.get("submission_id") == sid for j in subs)
+
+        # stop flow: long job submitted over REST, stopped over REST
+        sid2 = call(
+            "/api/jobs",
+            {"entrypoint": "python -c \"import time; time.sleep(600)\""},
+        )["submission_id"]
+        deadline = time.monotonic() + 60
+        while call(f"/api/jobs/{sid2}")["status"] != "RUNNING":
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        assert call(f"/api/jobs/{sid2}/stop", method="POST")["stopped"]
+        deadline = time.monotonic() + 60
+        while call(f"/api/jobs/{sid2}")["status"] == "RUNNING":
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+        assert call(f"/api/jobs/{sid2}")["status"] == "STOPPED"
+    finally:
+        dash.stop()

@@ -61,3 +61,94 @@ def test_actor_env_vars(rt):
     a = EnvActor.remote()
     assert ray_tpu.get(a.read.remote(), timeout=60) == "yes"
     ray_tpu.kill(a)
+
+
+def test_py_modules_isolated_by_env_keyed_pool(rt, tmp_path):
+    """Two jobs ship DIFFERENT versions of one module name: the worker
+    pool is keyed by runtime-env hash (reference worker_pool.h:280), so
+    each env gets its own worker process and the versions never collide
+    in one interpreter's sys.modules."""
+    for version in ("one", "two"):
+        d = tmp_path / f"v_{version}" / "rtenvmod"
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text(f"VALUE = '{version}'\n")
+
+    @ray_tpu.remote
+    def read_mod():
+        import os
+
+        import rtenvmod
+
+        return rtenvmod.VALUE, os.getpid()
+
+    env1 = {"py_modules": [str(tmp_path / "v_one" / "rtenvmod")]}
+    env2 = {"py_modules": [str(tmp_path / "v_two" / "rtenvmod")]}
+    v1, pid1 = ray_tpu.get(
+        read_mod.options(runtime_env=env1).remote(), timeout=120
+    )
+    v2, pid2 = ray_tpu.get(
+        read_mod.options(runtime_env=env2).remote(), timeout=120
+    )
+    assert (v1, v2) == ("one", "two")
+    assert pid1 != pid2  # distinct env-keyed workers
+
+    # warm reuse: the same env lands back on ITS worker, already booted
+    v1b, pid1b = ray_tpu.get(
+        read_mod.options(runtime_env=env1).remote(), timeout=120
+    )
+    assert v1b == "one" and pid1b == pid1
+
+
+def _write_test_wheel(wheel_dir, name="rtwheeltest", version="0.1",
+                      value=7):
+    """Handcraft a minimal pure-python wheel (a wheel is just a zip with
+    dist-info) — lets the offline pip plugin be tested with no index and
+    no build toolchain."""
+    import zipfile
+
+    os.makedirs(wheel_dir, exist_ok=True)
+    whl = os.path.join(wheel_dir, f"{name}-{version}-py3-none-any.whl")
+    di = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", f"VALUE = {value}\n")
+        zf.writestr(
+            f"{di}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        )
+        zf.writestr(
+            f"{di}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: test\n"
+            "Root-Is-Purelib: true\nTag: py3-none-any\n",
+        )
+        zf.writestr(f"{di}/RECORD", "")
+    return whl
+
+
+def test_pip_env_offline_install(rt):
+    """pip runtime env: venv + offline install from the default local
+    wheel dir; the worker boots inside the env's interpreter."""
+    import shutil
+    import subprocess
+    import sys
+
+    if subprocess.run(
+        [sys.executable, "-m", "pip", "--version"], capture_output=True
+    ).returncode != 0:
+        pytest.skip("pip unavailable")
+
+    wheel_dir = "/tmp/ray_tpu/wheels"  # config.pip_find_links default
+    _write_test_wheel(wheel_dir, value=7)
+    try:
+        @ray_tpu.remote(runtime_env={"pip": ["rtwheeltest"]})
+        def use_pkg():
+            import sys as s
+
+            import rtwheeltest
+
+            return rtwheeltest.VALUE, s.prefix
+
+        value, prefix = ray_tpu.get(use_pkg.remote(), timeout=300)
+        assert value == 7
+        assert "pip_envs" in prefix  # booted from the env's interpreter
+    finally:
+        shutil.rmtree(wheel_dir, ignore_errors=True)
