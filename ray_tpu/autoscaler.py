@@ -88,6 +88,140 @@ class LocalNodeProvider(NodeProvider):
             self.terminate_node(nid)
 
 
+class TpuPodProvider(NodeProvider):
+    """TPU-pod slice provider (mocked GKE backend): provisions WHOLE
+    slices as atoms, the way a cloud provider adds a multi-host TPU node
+    pool (reference autoscaler/_private/gcp/node_provider.py + the
+    KubeRay TPU webhook's slice semantics). One v5e-16 slice = 4 hosts x
+    4 chips; host 0 of each slice advertises the ``TPU-{pod}-head``
+    resource that SlicePlacementGroup's bundle 0 claims. The mock
+    backend spawns local node agents shaped like slice hosts; a real GKE
+    backend would create the node pool instead — everything above the
+    create/terminate calls is identical."""
+
+    def __init__(self, control_address: str, session_id: str,
+                 pod_type: str = "v5e-16", chips_per_host: int = 4):
+        from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+        self.control_address = control_address
+        self.session_id = session_id
+        self.pod_type = pod_type
+        self.chips_per_host = chips_per_host
+        self.hosts_per_slice = TPUAcceleratorManager.num_workers_in_slice(
+            pod_type
+        )
+        self._slices: Dict[str, List[tuple]] = {}  # slice_id -> [(nid, proc)]
+        self._next_slice = 0
+
+    def node_resources(self) -> Dict[str, float]:
+        return {"TPU": float(self.chips_per_host)}
+
+    def create_slice(self) -> List[str]:
+        """Provision one whole slice; returns its node ids (exactly
+        hosts_per_slice of them)."""
+        from ray_tpu.core.cluster_utils import spawn_node_agent
+
+        slice_id = f"{self.pod_type}-{self._next_slice}"
+        self._next_slice += 1
+        members: List[tuple] = []
+        node_ids: List[str] = []
+        for host in range(self.hosts_per_slice):
+            res: Dict[str, float] = {
+                "TPU": float(self.chips_per_host), "CPU": 1.0,
+            }
+            if host == 0:
+                res[f"TPU-{self.pod_type}-head"] = 1.0
+            proc, info = spawn_node_agent(
+                self.control_address, self.session_id, res,
+                labels={"tpu-pod-type": self.pod_type,
+                        "tpu-slice": slice_id},
+            )
+            members.append((info["node_id"], proc))
+            node_ids.append(info["node_id"])
+        self._slices[slice_id] = members
+        logger.info(
+            "provisioned TPU slice %s (%d hosts)", slice_id, len(members)
+        )
+        return node_ids
+
+    def create_node(self) -> str:
+        # single-node requests still provision a whole slice (slices are
+        # the provider's atom); callers wanting host granularity use the
+        # slice API
+        return self.create_slice()[0]
+
+    def slice_of(self, node_id: str) -> Optional[str]:
+        for sid, members in self._slices.items():
+            if any(nid == node_id for nid, _ in members):
+                return sid
+        return None
+
+    def slice_members(self, slice_id: str) -> List[str]:
+        """Node ids of one slice — the provider-interface contract the
+        autoscaler's busy-sibling and boot-settling checks rely on."""
+        return [nid for nid, _ in self._slices.get(slice_id, [])]
+
+    def all_slice_members(self) -> List[str]:
+        return [
+            nid for members in self._slices.values() for nid, _ in members
+        ]
+
+    def terminate_slice(self, slice_id: str) -> None:
+        members = self._slices.pop(slice_id, None)
+        if not members:
+            return
+        for _, proc in members:
+            try:
+                os.killpg(os.getpgid(proc.pid), 15)
+            except (ProcessLookupError, PermissionError):
+                proc.terminate()
+        logger.info("terminated TPU slice %s", slice_id)
+
+    def terminate_node(self, node_id: str) -> None:
+        sid = self.slice_of(node_id)
+        if sid is not None:
+            self.terminate_slice(sid)
+
+    def shutdown(self) -> None:
+        for sid in list(self._slices):
+            self.terminate_slice(sid)
+
+
+def pending_slice_demand(pgs: List[Dict[str, Any]],
+                         host_shape: Dict[str, float],
+                         head_resource: Optional[str] = None) -> int:
+    """Bin-pack pending placement-group bundles into hosts of
+    ``host_shape``: how many hosts would satisfy every TPU bundle of
+    every PENDING PG (reference autoscaler/v2/scheduler.py's shape
+    matching, specialized to the one node type this provider launches).
+    A bundle naming a ``TPU-<pod>-head`` resource fits ONLY when it
+    matches this provider's ``head_resource`` — a v5e-64 PG must never
+    drive a v5e-16 provider into provisioning slices that can't satisfy
+    it."""
+    hosts = 0
+    for pg in pgs:
+        if pg.get("state") not in ("PENDING", "RESCHEDULING"):
+            continue
+        for bundle in pg.get("bundles", []):
+            needs_tpu = any(
+                k == "TPU" or k.startswith("TPU-") for k in bundle
+            )
+            if not needs_tpu:
+                continue
+            heads = [k for k in bundle if k.startswith("TPU-")]
+            if any(h != head_resource for h in heads):
+                continue  # a different pod type's slice PG
+            fits = all(
+                v <= (
+                    1.0 if k == head_resource else host_shape.get(k, 0.0)
+                )
+                for k, v in bundle.items() if v > 0
+            )
+            if fits:
+                hosts += 1  # STRICT_SPREAD: one bundle per host
+    return hosts
+
+
 class Autoscaler:
     """Scale up while any node reports pending leases; scale an idle
     autoscaler-launched node down after idle_timeout_s."""
@@ -138,6 +272,46 @@ class Autoscaler:
         finally:
             client.close()
 
+    def _step_slices(self, client: RpcClient, nodes, n_alive: int) -> None:
+        """Slice-atom scale-up: pending SlicePlacementGroup demand maps
+        to WHOLE slices — a v5e-16 PG asks the provider for exactly its 4
+        hosts, never CPU fillers (reference: the GKE provider adds a
+        multi-host node pool per slice)."""
+        provider = self.provider
+        alive_ids = {n["node_id"] for n in nodes}
+        booting = [
+            nid for nid in provider.all_slice_members()
+            if nid not in alive_ids
+        ]
+        if booting:
+            # a previously-provisioned slice is still registering: wait
+            # for it before judging demand again, or one pending PG
+            # double-provisions every cooldown
+            return
+        try:
+            pgs = client.call("list_placement_groups", timeout_s=10.0)
+        except RpcError:
+            return
+        hosts_needed = pending_slice_demand(
+            pgs, provider.node_resources(),
+            head_resource=f"TPU-{provider.pod_type}-head",
+        )
+        if hosts_needed <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_upscale < self.upscale_cooldown_s:
+            return
+        per_slice = provider.hosts_per_slice
+        slices = -(-hosts_needed // per_slice)  # ceil
+        budget = max(0, self.max_nodes - n_alive) // per_slice
+        slices = min(slices, budget)
+        if slices <= 0:
+            return
+        self._last_upscale = now
+        for _ in range(slices):
+            for nid in provider.create_slice():
+                self._launched.append(nid)
+
     def _publish_infeasible(
         self, client: RpcClient, infeasible: List[Dict[str, float]],
         tmpl: Dict[str, float],
@@ -164,6 +338,8 @@ class Autoscaler:
         except RpcError:
             return
         n_alive = len(nodes)
+        if hasattr(self.provider, "create_slice"):
+            self._step_slices(client, nodes, n_alive)
         demand = sum(int(n.get("pending_leases", 0)) for n in nodes)
         # Shape-aware demand (reference autoscaler/v2/scheduler.py
         # bin-packs pending shapes into node types): upscale only when a
@@ -215,6 +391,18 @@ class Autoscaler:
             if demand > 0 or nid in busy_ids or n_alive <= self.min_nodes:
                 self._idle_since.pop(nid, None)
                 continue
+            if hasattr(self.provider, "slice_of"):
+                # slice atoms: terminate_node tears down the WHOLE slice,
+                # so an idle host whose slice SIBLING is busy must wait —
+                # never destroy a running actor on host 0 because host 3
+                # went quiet
+                sid = self.provider.slice_of(nid)
+                members = (
+                    set(self.provider.slice_members(sid)) if sid else set()
+                )
+                if members & busy_ids:
+                    self._idle_since.pop(nid, None)
+                    continue
             first = self._idle_since.setdefault(nid, now)
             if now - first >= self.idle_timeout_s:
                 # heartbeat lease counts can be up to a period stale: ask

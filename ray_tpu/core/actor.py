@@ -18,15 +18,18 @@ from ray_tpu.utils import serialization
 _ACTOR_OPTION_KEYS = {
     "name", "namespace", "lifetime", "max_restarts", "max_concurrency",
     "num_cpus", "num_tpus", "num_gpus", "resources", "scheduling_strategy",
-    "max_task_retries", "runtime_env",
+    "max_task_retries", "runtime_env", "concurrency_groups",
 }
 
 
-def method(num_returns=1, tensor_transport: str = "object"):
+def method(num_returns=1, tensor_transport: str = "object",
+           concurrency_group: Optional[str] = None):
     """Decorator configuring an actor method (parity: ray.method —
     including the RDT ``tensor_transport`` option, reference
-    gpu_object_manager.py, and ``num_returns="streaming"`` for generator
-    methods that yield through an ObjectRefGenerator)."""
+    gpu_object_manager.py; ``num_returns="streaming"`` for generator
+    methods that yield through an ObjectRefGenerator; and
+    ``concurrency_group`` routing the method onto a named per-group
+    thread pool, reference concurrency_group_manager.h:38)."""
 
     from ray_tpu.core.device_objects import validate_transport
 
@@ -35,6 +38,8 @@ def method(num_returns=1, tensor_transport: str = "object"):
     def wrap(fn):
         fn.__rt_num_returns__ = num_returns
         fn.__rt_tensor_transport__ = tensor_transport
+        if concurrency_group is not None:
+            fn.__rt_concurrency_group__ = concurrency_group
         return fn
 
     return wrap
@@ -96,6 +101,25 @@ class ActorClass:
         opts["resources"] = resources
         method_meta = self._method_meta()
         opts["method_names"] = sorted(method_meta)
+        groups = opts.get("concurrency_groups")
+        method_groups = {
+            name: getattr(fn, "__rt_concurrency_group__")
+            for name, fn in inspect.getmembers(self._cls, callable)
+            if getattr(fn, "__rt_concurrency_group__", None) is not None
+        }
+        if method_groups and not groups:
+            raise ValueError(
+                "methods declare concurrency_group "
+                f"{sorted(set(method_groups.values()))} but the actor has "
+                "no concurrency_groups option"
+            )
+        unknown = set(method_groups.values()) - set(groups or {})
+        if unknown:
+            raise ValueError(
+                f"methods reference undeclared concurrency groups "
+                f"{sorted(unknown)}"
+            )
+        opts["method_groups"] = method_groups
         actor_id = w.create_actor(
             class_id, blob, self.__name__, args, kwargs, opts
         )

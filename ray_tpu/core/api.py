@@ -227,7 +227,7 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
-    worker_mod.global_worker().cancel_task(ref)
+    worker_mod.global_worker().cancel_task(ref, force=force)
 
 
 def get_actor(name: str, namespace: str = "default") -> ActorHandle:

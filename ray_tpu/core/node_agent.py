@@ -178,6 +178,13 @@ class NodeAgent:
             self._terminate_worker(w)
         if self._data_sock is not None:
             try:
+                # wake any thread blocked in accept(2) — close alone
+                # leaves it parked on a reusable fd number (see
+                # RpcServer.stop)
+                self._data_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
                 self._data_sock.close()
             except OSError:
                 pass

@@ -126,7 +126,7 @@ def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str
     if wd and not isinstance(wd, dict):
         wd = os.path.abspath(wd)
         with _cache_lock:
-            digest = _uploaded.get(wd)
+            digest = _uploaded.get(("wd", wd))
         if digest is not None:
             out["working_dir"] = {"kv_key": digest}
             if out.get("env_vars") is not None:
@@ -138,7 +138,7 @@ def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str
             raise ValueError(f"working_dir {wd!r} is not a directory")
         digest = _upload_blob(_zip_dir(wd), control)
         with _cache_lock:
-            _uploaded[wd] = digest
+            _uploaded[("wd", wd)] = digest
         out["working_dir"] = {"kv_key": digest}
     mods = out.get("py_modules")
     if mods:
@@ -149,7 +149,9 @@ def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str
                 continue
             mod = os.path.abspath(mod)
             with _cache_lock:
-                digest = _uploaded.get(mod)
+                # keyed by (kind, path): a py_modules zip carries the
+                # package-name arc prefix a working_dir zip must not
+                digest = _uploaded.get(("mod", mod))
             if digest is None:
                 if not os.path.isdir(mod):
                     raise ValueError(
@@ -162,7 +164,7 @@ def prepare(runtime_env: Optional[Dict[str, Any]], control) -> Optional[Dict[str
                     control,
                 )
                 with _cache_lock:
-                    _uploaded[mod] = digest
+                    _uploaded[("mod", mod)] = digest
             prepared.append(
                 {"kv_key": digest, "name": os.path.basename(mod)}
             )

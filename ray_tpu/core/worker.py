@@ -284,11 +284,23 @@ class ReferenceTracker:
 class _ActorRuntime:
     """Executor-side state when this worker hosts an actor."""
 
-    def __init__(self, actor_id: str, instance, max_concurrency: int):
+    def __init__(self, actor_id: str, instance, max_concurrency: int,
+                 concurrency_groups: Optional[Dict[str, int]] = None,
+                 method_groups: Optional[Dict[str, str]] = None):
         self.actor_id = actor_id
         self.instance = instance
         self.max_concurrency = max_concurrency
-        self.queue: "queue.Queue" = queue.Queue()
+        # Concurrency groups (reference
+        # task_execution/concurrency_group_manager.h:38): each named
+        # group gets its OWN queue + thread pool sized to its limit, so a
+        # saturated "io" group can never starve "compute" — ungrouped
+        # methods ride the default pool of max_concurrency threads.
+        self.queue: "queue.Queue" = queue.Queue()  # default group
+        self.group_queues: Dict[str, "queue.Queue"] = {
+            g: queue.Queue() for g in (concurrency_groups or {})
+        }
+        self.group_limits: Dict[str, int] = dict(concurrency_groups or {})
+        self.method_groups: Dict[str, str] = dict(method_groups or {})
         self.threads: List[threading.Thread] = []
         self.running = 0  # executions in flight (guarded by running_lock)
         self.running_lock = threading.Lock()
@@ -306,6 +318,17 @@ class _ActorRuntime:
         self.is_async = any(
             inspect.iscoroutinefunction(m)
             for _, m in inspect.getmembers(instance, callable)
+        )
+
+    def queue_for(self, method_name: str) -> "queue.Queue":
+        group = self.method_groups.get(method_name)
+        if group is not None and group in self.group_queues:
+            return self.group_queues[group]
+        return self.queue
+
+    def total_queued(self) -> int:
+        return self.queue.qsize() + sum(
+            q.qsize() for q in self.group_queues.values()
         )
 
     def ensure_loop(self):
@@ -1668,6 +1691,13 @@ class CoreWorker:
         return True
 
     def _store_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        if reply.get("status") == "interrupted":
+            # a stray cancel interrupt hit this (innocent) task: surface
+            # it as a crash so every retry ladder treats it as retryable
+            # (the lease-cache path also special-cases it pre-store)
+            raise WorkerCrashedError(
+                f"task {spec.name} caught a stray cancel interrupt"
+            )
         if reply["status"] != "error" or not spec.retry_exceptions:
             # terminal (the retry_exceptions error path re-raises to the
             # retry loop: the task is still pending, so its args keep
@@ -1742,6 +1772,8 @@ class CoreWorker:
             "max_restarts": actor_options.get("max_restarts", 0),
             "max_task_retries": actor_options.get("max_task_retries", 0),
             "max_concurrency": actor_options.get("max_concurrency", 1),
+            "concurrency_groups": actor_options.get("concurrency_groups"),
+            "method_groups": actor_options.get("method_groups"),
             "method_names": actor_options.get("method_names", []),
             "scheduling_strategy": strategy,
             "runtime_env": runtime_env,
@@ -1899,19 +1931,22 @@ class CoreWorker:
             self._restartable_actor_inits.discard(actor_id)
             self._release_arg_pins(f"actor_init_{actor_id}")
 
-    def cancel_task(self, ref: ObjectRef) -> None:
-        """Best-effort cancel (reference core_worker.h Cancel): tasks not
-        yet dispatched are dropped owner-side; tasks already pushed get a
-        cancel RPC so the executor skips them if they haven't started.
-        A task already running is not interrupted (force-cancel is a later
-        round: it needs executor-side thread interruption)."""
+    def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
+        """Cancel (reference core_worker.h Cancel): tasks not yet
+        dispatched are dropped owner-side; tasks already pushed get a
+        cancel RPC. A RUNNING task is interrupted executor-side:
+        force=False raises KeyboardInterrupt in its thread (the
+        reference's non-force semantics), force=True kills the executing
+        worker process outright (a task stuck in C code or refusing the
+        interrupt still dies; the owner's retry ladder sees the
+        cancellation and stores TaskCancelledError instead of retrying)."""
         task_hex = ref.task_id().hex()
         self._cancelled_tasks.add(task_hex)
         worker_addr = self._inflight_push.get(task_hex)
         if worker_addr:
             try:
                 self.workers.get(worker_addr).call_oneway(
-                    "cancel_task", task_id_hex=task_hex
+                    "cancel_task", task_id_hex=task_hex, force=force
                 )
             except RpcError:
                 pass
@@ -1940,33 +1975,59 @@ class CoreWorker:
                 RemoteError("this worker hosts no actor", ""),
             )
             return
-        rt.queue.put((conn, req_id, spec))
+        rt.queue_for(spec.method_name).put((conn, req_id, spec))
 
-    def _actor_loop(self) -> None:
+    def _actor_loop(self, q: "queue.Queue") -> None:
         rt = self._actor_runtime
         while not self._shutdown.is_set():
             try:
-                conn, req_id, spec = rt.queue.get(timeout=0.5)
+                conn, req_id, spec = q.get(timeout=0.5)
             except queue.Empty:
                 continue
-            if rt.is_async:
-                # Async actor (any `async def` method makes the WHOLE
-                # actor async, like the reference): every method runs on
-                # the one event loop — coroutines overlap at awaits, sync
-                # methods run to completion on the loop thread — so actor
-                # state is single-threaded and scheduling order follows
-                # submission order. The executor thread frees immediately;
-                # the reply is sent from a pool thread on completion.
-                self._execute_async_actor_task(conn, req_id, spec)
-                continue
-            with rt.running_lock:
-                rt.running += 1
             try:
-                reply = self._execute_spec(spec)
-            finally:
-                with rt.running_lock:
-                    rt.running -= 1
-            RpcServer.reply(conn, req_id, True, reply)
+                if rt.is_async:
+                    # Async actor (any `async def` method makes the WHOLE
+                    # actor async, like the reference): every method runs
+                    # on the one event loop — coroutines overlap at
+                    # awaits, sync methods run to completion on the loop
+                    # thread — so actor state is single-threaded and
+                    # scheduling order follows submission order. The
+                    # executor thread frees immediately; the reply is sent
+                    # from a pool thread on completion.
+                    self._execute_async_actor_task(conn, req_id, spec)
+                    continue
+                incremented = False
+                try:
+                    with rt.running_lock:
+                        rt.running += 1
+                        incremented = True
+                    reply = self._execute_spec(spec)
+                except KeyboardInterrupt:
+                    # stray cancel interrupt delivered outside
+                    # _execute_spec's try block: this persistent executor
+                    # thread must survive
+                    reply = {"status": "interrupted"}
+                finally:
+                    if incremented:
+                        with rt.running_lock:
+                            rt.running -= 1
+                try:
+                    RpcServer.reply(conn, req_id, True, reply)
+                except KeyboardInterrupt:
+                    # mid-send interrupt may have written a partial frame:
+                    # resending would desync the multiplexed stream — drop
+                    # the connection instead (the caller's conn-loss path
+                    # classifies and retries)
+                    conn.alive = False
+                    try:
+                        conn.sock.close()
+                    except OSError:
+                        pass
+            except KeyboardInterrupt:
+                # parked in q.get() (or bookkeeping) when a stray
+                # interrupt landed: nothing was dequeued-and-lost that a
+                # retry won't cover — keep this persistent thread alive
+                continue
 
     def _execute_async_actor_task(self, conn, req_id, spec: TaskSpec) -> None:
         import asyncio
@@ -2043,7 +2104,7 @@ class CoreWorker:
             return None
         with rt.running_lock:
             running = rt.running
-        out = {"queued": rt.queue.qsize(), "running": running}
+        out = {"queued": rt.total_queued(), "running": running}
         # serve model multiplexing: piggyback the replica's loaded model
         # ids on the out-of-band probe (no extra RPC, and no import cost
         # unless the process actually uses @serve.multiplexed)
@@ -2086,15 +2147,26 @@ class CoreWorker:
                 ),
             }
         rt = _ActorRuntime(
-            spec["actor_id"], instance, int(spec.get("max_concurrency", 1))
+            spec["actor_id"], instance, int(spec.get("max_concurrency", 1)),
+            concurrency_groups=spec.get("concurrency_groups"),
+            method_groups=spec.get("method_groups"),
         )
         self._actor_runtime = rt
         for i in range(rt.max_concurrency):
             t = threading.Thread(
-                target=self._actor_loop, name=f"actor-exec-{i}", daemon=True
+                target=self._actor_loop, args=(rt.queue,),
+                name=f"actor-exec-{i}", daemon=True,
             )
             t.start()
             rt.threads.append(t)
+        for group, limit in rt.group_limits.items():
+            for i in range(max(1, int(limit))):
+                t = threading.Thread(
+                    target=self._actor_loop, args=(rt.group_queues[group],),
+                    name=f"actor-{group}-{i}", daemon=True,
+                )
+                t.start()
+                rt.threads.append(t)
         return {"ok": True}
 
     def _execute_spec(self, spec: TaskSpec) -> Dict[str, Any]:
@@ -2102,7 +2174,9 @@ class CoreWorker:
             return {"status": "cancelled"}
         self._current_ctx.task_id = spec.task_id
         self._current_ctx.job_id = spec.task_id.job_id()
-        self._running_tasks[spec.task_id.hex()] = {"name": spec.name}
+        self._running_tasks[spec.task_id.hex()] = {
+            "name": spec.name, "tid": threading.get_ident(),
+        }
         _t0 = time.time()
         try:
             if spec.actor_id is not None:
@@ -2140,6 +2214,13 @@ class CoreWorker:
                     result = target(*args, **kwargs)
             returns = self._package_returns(spec, result)
             return {"status": "ok", "returns": returns}
+        except KeyboardInterrupt:
+            if spec.task_id.hex() in self._cancelled_tasks:
+                return {"status": "cancelled"}
+            # a cancel aimed at a task that finished in the delivery
+            # window landed here instead: this task is innocent — report
+            # "interrupted" so the owner retries it rather than failing
+            return {"status": "interrupted"}
         except TaskError as e:
             return {"status": "error", "error": e}
         except Exception as e:  # noqa: BLE001 — forwarded to the owner
@@ -2442,8 +2523,31 @@ class CoreWorker:
         self.reference_tracker.owner_release_borrow(ObjectID.from_hex(oid_hex), n=n)
         return True
 
-    def rpc_cancel_task(self, conn, task_id_hex: str):
+    def rpc_cancel_task(self, conn, task_id_hex: str, force: bool = False):
         self._cancelled_tasks.add(task_id_hex)
+        running = self._running_tasks.get(task_id_hex)
+        if running is None:
+            return True
+        if force:
+            # force-cancel semantics (reference: force=True kills the
+            # worker): the task may be wedged in native code where no
+            # Python exception can land. The owner detects the connection
+            # loss; the cancelled task stores TaskCancelledError and any
+            # batch peers retry elsewhere.
+            logger.warning(
+                "force-cancel: killing worker over task %s", task_id_hex[:16]
+            )
+            os.kill(os.getpid(), 9)
+            return True  # unreachable
+        tid = running.get("tid")
+        if tid is not None:
+            import ctypes
+
+            # the reference raises KeyboardInterrupt in the executing
+            # thread for non-force cancellation of a running task
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(KeyboardInterrupt)
+            )
         return True
 
     def rpc_ping(self, conn):
@@ -2978,7 +3082,11 @@ class _NormalTaskSubmitter:
             self.pending.append(spec)
             return
         self.attempts.pop(task_hex, None)
-        if not isinstance(err, TaskError):
+        if task_hex in w._cancelled_tasks:
+            # a force-cancel kills the worker: the resulting connection
+            # loss is the CANCELLATION landing, not a crash
+            err = TaskCancelledError(f"task {spec.name} was cancelled")
+        elif not isinstance(err, TaskError):
             err = TaskError(
                 f"task {spec.name} failed after {used} attempts: {err}"
             )
@@ -3065,6 +3173,19 @@ class _NormalTaskSubmitter:
         retry = []
         for spec, reply in zip(specs, replies):
             task_hex = spec.task_id.hex()
+            if (
+                isinstance(reply, dict)
+                and reply.get("status") == "interrupted"
+            ):
+                # a stray cancel interrupt hit this (innocent) task on
+                # the executor: always retryable
+                retry.append((
+                    spec,
+                    WorkerCrashedError(
+                        f"task {spec.name} caught a stray cancel interrupt"
+                    ),
+                ))
+                continue
             try:
                 w._store_task_reply(spec, reply)
                 with self.lock:

@@ -8,10 +8,12 @@ checkpoints).
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.trainable import Trainable, with_resources
 from ray_tpu.tune.search import (
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -28,6 +30,8 @@ from ray_tpu.tune.tuner import ResultGrid, TrialResult, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "TPESearcher",
     "PopulationBasedTraining",
     "Trainable",
     "get_checkpoint",

@@ -162,3 +162,52 @@ class PopulationBasedTraining:
         self._configs[trial_id] = dict(new_config)
         self.exploit_count += 1
         return ("EXPLOIT", source, new_config)
+
+
+class HyperBandScheduler:
+    """HyperBand (asynchronous-bracket formulation): trials are assigned
+    round-robin to brackets whose grace periods span
+    ``grace_period * rf^k`` up to max_t, and each bracket runs ASHA-style
+    successive halving at its own rungs. This is the multi-bracket
+    generalization of ASHA the HyperBand paper reduces to under async
+    arrival (the role the reference's hyperband.py / hb_bohb.py family
+    plays; the synchronous cohort barrier is deliberately dropped — it
+    wastes cluster time waiting for stragglers and can deadlock with
+    early-stopped trials)."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        max_t: int = 81,
+        grace_period: int = 1,
+        reduction_factor: int = 3,
+        time_attr: str = "training_iteration",
+    ):
+        self.brackets: List[ASHAScheduler] = []
+        t = grace_period
+        while t <= max_t:
+            self.brackets.append(
+                ASHAScheduler(
+                    metric, mode=mode, max_t=max_t, grace_period=t,
+                    reduction_factor=reduction_factor, time_attr=time_attr,
+                )
+            )
+            t *= reduction_factor
+        self._assignment: Dict[str, int] = {}
+        self._next = 0
+
+    def on_trial_add(self, trial_id: str, config: Dict) -> None:
+        self._assignment[trial_id] = self._next % len(self.brackets)
+        self._next += 1
+
+    def _bracket(self, trial_id: str) -> ASHAScheduler:
+        idx = self._assignment.get(trial_id)
+        if idx is None:  # trial added without on_trial_add (restore path)
+            idx = self._next % len(self.brackets)
+            self._assignment[trial_id] = idx
+            self._next += 1
+        return self.brackets[idx]
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return self._bracket(trial_id).on_result(trial_id, result)

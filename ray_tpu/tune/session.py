@@ -28,10 +28,13 @@ def get_checkpoint() -> Optional[str]:
     return getattr(_local, "restore_from", None)
 
 
-def report(metrics: Dict[str, Any],
-           checkpoint: Optional[Dict[str, Any]] = None) -> None:
+def report(metrics: Optional[Dict[str, Any]] = None,
+           checkpoint: Optional[Dict[str, Any]] = None,
+           **kwargs: Any) -> None:
     """Record one result for this trial (and optionally persist a
-    checkpoint dict under the trial dir)."""
+    checkpoint dict under the trial dir). Accepts a metrics dict, bare
+    keyword metrics, or both (reference: both tune.report styles)."""
+    metrics = {**(metrics or {}), **kwargs}
     cb = getattr(_local, "report_cb", None)
     if cb is None:
         raise RuntimeError("tune.report() called outside a tune trial")

@@ -32,6 +32,8 @@ class TuneConfig:
         max_concurrent_trials: int = 4,
         scheduler=None,
         seed: Optional[int] = None,
+        search_alg=None,
+        max_failures: int = 0,
     ):
         if mode not in ("min", "max"):
             raise ValueError("mode must be 'min' or 'max'")
@@ -41,6 +43,14 @@ class TuneConfig:
         self.max_concurrent_trials = max_concurrent_trials
         self.scheduler = scheduler or sched_mod.FIFOScheduler()
         self.seed = seed
+        # model-based search (e.g. search.TPESearcher): configs are
+        # SUGGESTED one at a time from completed-trial history instead of
+        # pre-sampled (reference: tune search_alg / optuna integration)
+        self.search_alg = search_alg
+        # trial fault tolerance: a trial whose runner dies is relaunched
+        # from its latest checkpoint up to this many times (reference
+        # FailureConfig(max_failures))
+        self.max_failures = max_failures
 
 
 class TrialResult:
@@ -209,21 +219,29 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         cfg = self._cfg
-        configs = generate_trials(
-            self._param_space, cfg.num_samples, seed=cfg.seed
-        )
+        if cfg.search_alg is not None:
+            cfg.search_alg.set_search_space(self._param_space)
+            pending = [
+                (f"trial_{i:04d}", None) for i in range(cfg.num_samples)
+            ]
+        else:
+            configs = generate_trials(
+                self._param_space, cfg.num_samples, seed=cfg.seed
+            )
+            pending = [
+                (f"trial_{i:04d}", c) for i, c in enumerate(configs)
+            ]
         fn_blob = serialization.dumps_function(self._trainable)
-        pending = [
-            (f"trial_{i:04d}", c) for i, c in enumerate(configs)
-        ]
-        results = {tid: TrialResult(tid, c) for tid, c in pending}
+        results = {
+            tid: TrialResult(tid, c) for tid, c in pending if c is not None
+        }
         running: Dict[str, Dict[str, Any]] = {}  # tid -> {actor, run_ref}
         os.makedirs(self._run_dir, exist_ok=True)
 
         from ray_tpu.tune.trainable import trial_resources
 
         resources = trial_resources(self._trainable) or {}
-        if hasattr(cfg.scheduler, "on_trial_add"):
+        if hasattr(cfg.scheduler, "on_trial_add") and cfg.search_alg is None:
             for tid, c in pending:
                 cfg.scheduler.on_trial_add(tid, c)
 
@@ -262,6 +280,8 @@ class Tuner:
                    error: Optional[str] = None) -> None:
             rec = running.pop(tid)
             res = results[tid]
+            if cfg.search_alg is not None:
+                cfg.search_alg.on_trial_complete(tid, res.metrics)
             res.stopped_early = stopped_early
             if error:
                 res.error = error
@@ -279,6 +299,11 @@ class Tuner:
         while pending or running:
             while pending and len(running) < cfg.max_concurrent_trials:
                 tid, config = pending.pop(0)
+                if config is None:  # model-based: suggest from history
+                    config = cfg.search_alg.suggest(tid)
+                    results[tid] = TrialResult(tid, config)
+                    if hasattr(cfg.scheduler, "on_trial_add"):
+                        cfg.scheduler.on_trial_add(tid, config)
                 launch(tid, config)
             time.sleep(0.1)
             for tid in list(running):
@@ -300,6 +325,29 @@ class Tuner:
                         finish(tid, error="trial runner unresponsive")
                     continue
                 except Exception as e:  # noqa: BLE001 — runner died
+                    failures = rec.get("failures", 0)
+                    ckpt = self._latest_checkpoint(tid)
+                    if failures < cfg.max_failures:
+                        # trial FT (reference FailureConfig + tune
+                        # controller restore, tune_controller.py:1691):
+                        # relaunch from the latest checkpoint
+                        logger.warning(
+                            "trial %s runner died (%s); restoring from %s "
+                            "(failure %d/%d)",
+                            tid, e, ckpt, failures + 1, cfg.max_failures,
+                        )
+                        # no checkpoint yet -> fresh restart (reference
+                        # FailureConfig restarts from scratch then)
+                        prev_iter = rec["iter"] if ckpt is not None else 0
+                        running.pop(tid)
+                        try:
+                            ray_tpu.kill(rec["actor"])
+                        except Exception:  # noqa: BLE001
+                            pass
+                        launch(tid, results[tid].config,
+                               restore_from=ckpt, prev_iter=prev_iter)
+                        running[tid]["failures"] = failures + 1
+                        continue
                     finish(tid, error=f"trial runner died: {e}")
                     continue
                 res = results[tid]

@@ -171,14 +171,23 @@ class Gateway:
 
     def stop(self) -> None:
         self._stopped.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        for sock in [self._listener]:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)  # wakes blocked accept(2)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
         with self._lock:
             binds = list(self._binds.values())
             self._binds.clear()
         for b in binds:
+            try:
+                b.listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 b.listener.close()
             except OSError:

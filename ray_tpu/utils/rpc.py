@@ -207,6 +207,15 @@ class RpcServer:
     def stop(self) -> None:
         self._stopped.set()
         try:
+            # shutdown() BEFORE close: close(2) does not wake a thread
+            # blocked in accept(2) — it would stay parked on the old fd
+            # NUMBER, and once the kernel reuses that number for a new
+            # listener in this process, the zombie thread steals and
+            # instantly drops the new server's connections
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._listener.close()
         except OSError:
             pass
@@ -223,6 +232,14 @@ class RpcServer:
             try:
                 sock, addr = self._listener.accept()
             except OSError:
+                return
+            if self._stopped.is_set():
+                # belt for the fd-reuse race: a stolen accept on a reused
+                # fd must drop the socket without serving it
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = ClientConnection(sock, addr)
@@ -294,6 +311,12 @@ class RpcServer:
                 raise RpcError(f"no handler for method {method!r} on {self.name}")
             result = handler(conn, *args, **kwargs)
             ok, payload = True, result
+        except KeyboardInterrupt:
+            # a cancel interrupt aimed at a task that already finished can
+            # land in this (per-request) dispatch thread: answer with a
+            # retryable error instead of dying reply-less
+            ok = False
+            payload = RemoteError("KeyboardInterrupt: stray cancel", "")
         except Exception as e:  # noqa: BLE001 — faithfully forward any error
             ok = False
             payload = RemoteError(
@@ -309,6 +332,15 @@ class RpcServer:
             )
         except OSError:
             conn.alive = False
+        except KeyboardInterrupt:
+            # stray cancel interrupt mid-send: a partial frame may be on
+            # the wire, so resending would desync the multiplexed stream
+            # — drop the connection (the caller retries on conn loss)
+            conn.alive = False
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------

@@ -141,3 +141,47 @@ def test_feasible_shape_still_scales():
             ray_tpu.shutdown()
         finally:
             c.shutdown()
+
+
+def test_tpu_pod_provider_scales_slice_for_pg():
+    """A pending v5e-16 SlicePlacementGroup makes the pod provider
+    provision exactly one slice (4 hosts x 4 chips) and the PG goes
+    READY on it — slice demand scales slices, not CPU fillers."""
+    from ray_tpu.accelerators.slice_pg import slice_placement_group
+    from ray_tpu.autoscaler import Autoscaler, TpuPodProvider
+
+    c = Cluster()
+    scaler = None
+    try:
+        c.add_node(num_cpus=1)  # CPU-only head: no TPU capacity at all
+        ray_tpu.init(address=c.address)
+        provider = TpuPodProvider(
+            c.address, c.session_id, pod_type="v5e-16", chips_per_host=4
+        )
+        assert provider.hosts_per_slice == 4
+        scaler = Autoscaler(
+            c.address, provider, min_nodes=1, max_nodes=8,
+            idle_timeout_s=120.0, poll_period_s=0.3, upscale_cooldown_s=0.5,
+        )
+        scaler.start()
+
+        spg = slice_placement_group("v5e-16", chips_per_host=4)
+        assert spg.wait(timeout_seconds=120), "slice PG never became ready"
+        # exactly one slice was provisioned: 4 TPU hosts
+        assert len(provider._slices) == 1
+        (members,) = provider._slices.values()
+        assert len(members) == 4
+        tpu_nodes = [
+            n for n in ray_tpu.nodes()
+            if n.get("alive", True)
+            and n.get("labels", {}).get("tpu-pod-type") == "v5e-16"
+        ]
+        assert len(tpu_nodes) == 4
+        spg.remove()
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        try:
+            ray_tpu.shutdown()
+        finally:
+            c.shutdown()

@@ -1,0 +1,144 @@
+"""Force-cancel + actor concurrency groups (parity models: reference
+core_worker Cancel semantics and concurrency_group_manager.h)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import TaskCancelledError
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_cancel_running_task_interrupt(rt):
+    """Non-force cancel raises KeyboardInterrupt inside the running
+    task's thread; the caller sees TaskCancelledError."""
+    @ray_tpu.remote
+    def spin():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60:
+            time.sleep(0.01)  # interruptible spin
+        return "finished"
+
+    ref = spin.remote()
+    time.sleep(1.0)  # let it start
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_force_cancel_kills_wedged_task(rt):
+    """force=True kills the worker outright — even a task that swallows
+    KeyboardInterrupt dies."""
+    @ray_tpu.remote(max_retries=3)
+    def wedged():
+        while True:
+            try:
+                time.sleep(0.05)
+            except KeyboardInterrupt:
+                continue  # refuses the polite interrupt
+
+    ref = wedged.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+
+    # the cluster still works afterwards (worker pool respawns)
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 1
+
+
+def test_cancel_queued_task(rt):
+    """A task cancelled before dispatch never runs."""
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(3)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    h = hog.remote()
+    q = queued.remote()  # waits behind the hog for all 4 CPUs
+    ray_tpu.cancel(q)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    assert ray_tpu.get(h, timeout=30) == "hog"
+
+
+def test_concurrency_groups_isolate_pools(rt):
+    """A saturated group must not starve another group's methods."""
+    @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 2})
+    class Worker:
+        @ray_tpu.method(concurrency_group="io")
+        def block_io(self):
+            time.sleep(5)
+            return "io"
+
+        @ray_tpu.method(concurrency_group="compute")
+        def fast_compute(self):
+            return "compute"
+
+        def default_method(self):
+            return "default"
+
+    w = Worker.remote()
+    ray_tpu.get(w.default_method.remote(), timeout=60)  # alive
+    blockers = [w.block_io.remote() for _ in range(4)]  # io full + queued
+    time.sleep(0.5)
+    t0 = time.monotonic()
+    assert ray_tpu.get(w.fast_compute.remote(), timeout=30) == "compute"
+    assert ray_tpu.get(w.default_method.remote(), timeout=30) == "default"
+    assert time.monotonic() - t0 < 3.0, "io group starved other pools"
+    assert ray_tpu.get(blockers, timeout=60) == ["io"] * 4
+
+
+def test_concurrency_group_limit_enforced(rt):
+    """At most `limit` calls of a group run concurrently."""
+    @ray_tpu.remote(concurrency_groups={"g": 2}, max_concurrency=8)
+    class Probe:
+        def __init__(self):
+            self.active = 0
+            self.peak = 0
+            import threading
+
+            self.lock = threading.Lock()
+
+        @ray_tpu.method(concurrency_group="g")
+        def run(self):
+            with self.lock:
+                self.active += 1
+                self.peak = max(self.peak, self.active)
+            time.sleep(0.3)
+            with self.lock:
+                self.active -= 1
+            return True
+
+        def peak_seen(self):
+            return self.peak
+
+    p = Probe.remote()
+    ray_tpu.get([p.run.remote() for _ in range(6)], timeout=60)
+    assert ray_tpu.get(p.peak_seen.remote(), timeout=30) == 2
+
+
+def test_undeclared_group_rejected(rt):
+    with pytest.raises(ValueError):
+        @ray_tpu.remote
+        class Bad:
+            @ray_tpu.method(concurrency_group="nope")
+            def f(self):
+                return 1
+
+        Bad.remote()

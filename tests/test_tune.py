@@ -250,3 +250,126 @@ def test_with_resources_per_trial(rt):
     grid = tuner.fit()
     assert grid.num_errors == 0
     assert grid.get_best_result().metrics["score"] == 3
+
+
+def test_tpe_searcher_converges_unit():
+    """TPE beats random on a smooth 1-D objective: after warmup, its
+    suggestions concentrate near the optimum (x*=0.3)."""
+    from ray_tpu.tune.search import TPESearcher
+
+    searcher = TPESearcher(metric="loss", mode="min", n_startup=10, seed=0)
+    searcher.set_search_space({"x": tune.uniform(0.0, 1.0)})
+    for i in range(60):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        loss = (cfg["x"] - 0.3) ** 2
+        searcher.on_trial_complete(tid, {"loss": loss})
+    late = []
+    for i in range(20):
+        tid = f"probe{i}"
+        cfg = searcher.suggest(tid)
+        late.append(cfg["x"])
+        searcher.on_trial_complete(tid, {"loss": (cfg["x"] - 0.3) ** 2})
+    mean_err = sum(abs(x - 0.3) for x in late) / len(late)
+    assert mean_err < 0.15, f"TPE not concentrating: mean err {mean_err}"
+
+
+def test_tpe_categorical_unit():
+    from ray_tpu.tune.search import TPESearcher
+
+    searcher = TPESearcher(metric="score", mode="max", n_startup=8, seed=1)
+    searcher.set_search_space({"opt": tune.choice(["bad", "good", "worse"])})
+    for i in range(40):
+        tid = f"t{i}"
+        cfg = searcher.suggest(tid)
+        score = {"bad": 0.2, "good": 1.0, "worse": 0.0}[cfg["opt"]]
+        searcher.on_trial_complete(tid, {"score": score})
+    picks = [searcher.suggest(f"p{i}")["opt"] for i in range(10)]
+    assert picks.count("good") >= 7
+
+
+def test_hyperband_scheduler_unit():
+    """Brackets have different grace periods; early trials in the most
+    aggressive bracket stop at rung 1 while the conservative bracket
+    lets them run."""
+    hb = tune.HyperBandScheduler(metric="acc", mode="max", max_t=9,
+                                 grace_period=1, reduction_factor=3)
+    assert len(hb.brackets) == 3  # grace 1, 3, 9
+    hb.on_trial_add("a", {})  # bracket 0 (grace 1)
+    hb.on_trial_add("b", {})  # bracket 1 (grace 3)
+    # bracket 0 judges at iteration 1; a bad report can stop there
+    from ray_tpu.tune import schedulers as sched_mod
+
+    for v in (0.9, 0.8, 0.7):
+        hb.brackets[0].on_result(f"seed{v}", {"acc": v,
+                                              "training_iteration": 1})
+    out_a = hb.on_result("a", {"acc": 0.01, "training_iteration": 1})
+    assert out_a == sched_mod.STOP
+    # bracket 1's first rung is 3: iteration-1 reports never stop it
+    out_b = hb.on_result("b", {"acc": 0.01, "training_iteration": 1})
+    assert out_b == sched_mod.CONTINUE
+
+
+def test_tpe_end_to_end_with_tuner(rt, tmp_path):
+    """Model-based search wired through the Tuner: configs come from
+    suggest(), completions feed back, best result lands near optimum."""
+    from ray_tpu.tune.search import TPESearcher
+
+    def objective(config):
+        loss = (config["x"] - 0.5) ** 2 + 0.01
+        tune.report(loss=loss)
+
+    searcher = TPESearcher(metric="loss", mode="min", n_startup=6, seed=2)
+    tuner = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=18,
+            max_concurrent_trials=3, search_alg=searcher,
+        ),
+        run_dir=str(tmp_path),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["loss"] < 0.05  # found the basin
+
+
+def test_trial_restores_after_runner_death(rt, tmp_path):
+    """Kill a trial's runner process mid-run: with max_failures, the
+    trial restores from its latest checkpoint and completes (reference
+    FailureConfig + tune controller restore)."""
+    import os
+
+    def trainable(config):
+        import os as os_mod
+
+        ckpt = tune.get_checkpoint()
+        start = (tune.load_checkpoint(ckpt)["step"] + 1) if ckpt else 0
+        marker = config["marker"]
+        for step in range(start, 6):
+            tune.report(step=step, score=float(step),
+                        checkpoint={"step": step})
+            if step == 2 and not os_mod.path.exists(marker):
+                open(marker, "w").close()
+                os_mod.kill(os_mod.getpid(), 9)  # die mid-trial, once
+
+    marker = str(tmp_path / "died_once")
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"marker": marker},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=1, max_failures=2,
+        ),
+        run_dir=str(tmp_path / "run"),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.error is None, best.error
+    assert best.metrics["score"] == 5.0
+    assert os.path.exists(marker)  # it really did die once
+    # restored from checkpoint: steps stay monotone with no restart
+    # duplicates (a from-scratch restart would re-report step 0; reports
+    # still buffered in the killed runner are legitimately lost)
+    steps = [r["step"] for r in best.all_reports]
+    assert steps[-1] == 5
+    assert steps == sorted(set(steps))
