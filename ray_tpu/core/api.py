@@ -227,6 +227,13 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    """Cancel a task (reference core_worker.h Cancel): queued tasks are
+    dropped, a running task gets KeyboardInterrupt, force=True kills its
+    worker. ``recursive`` is accepted for signature parity but child
+    tasks spawned by the cancelled task are NOT chased — ownership of
+    children lives with the executing worker, which force-kill tears
+    down anyway; a cooperative child-cancellation protocol is future
+    work."""
     worker_mod.global_worker().cancel_task(ref, force=force)
 
 

@@ -1693,8 +1693,13 @@ class CoreWorker:
     def _store_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
         if reply.get("status") == "interrupted":
             # a stray cancel interrupt hit this (innocent) task: surface
-            # it as a crash so every retry ladder treats it as retryable
+            # it in the type each retry ladder classifies as retryable
             # (the lease-cache path also special-cases it pre-store)
+            if spec.actor_id is not None:
+                raise ActorUnavailableError(
+                    f"actor task {spec.name} caught a stray cancel "
+                    "interrupt"
+                )
             raise WorkerCrashedError(
                 f"task {spec.name} caught a stray cancel interrupt"
             )
@@ -2024,9 +2029,16 @@ class CoreWorker:
                     except OSError:
                         pass
             except KeyboardInterrupt:
-                # parked in q.get() (or bookkeeping) when a stray
-                # interrupt landed: nothing was dequeued-and-lost that a
-                # retry won't cover — keep this persistent thread alive
+                # stray interrupt outside the guarded regions: a just-
+                # dequeued item or a computed-but-unsent reply may be
+                # lost, so DROP the connection — the caller's conn-loss
+                # path retries per its policy instead of hanging forever
+                # — and keep this persistent thread alive
+                try:
+                    conn.alive = False
+                    conn.sock.close()
+                except (OSError, NameError, AttributeError):
+                    pass  # interrupt landed before a conn was dequeued
                 continue
 
     def _execute_async_actor_task(self, conn, req_id, spec: TaskSpec) -> None:
@@ -2543,6 +2555,13 @@ class CoreWorker:
         if tid is not None:
             import ctypes
 
+            # re-verify IDENTITY at the last instant: _execute_spec pops
+            # the entry in its finally before the thread can exit, so an
+            # entry that is still present with the same tid cannot belong
+            # to a reused thread ident
+            current = self._running_tasks.get(task_id_hex)
+            if current is None or current.get("tid") != tid:
+                return True
             # the reference raises KeyboardInterrupt in the executing
             # thread for non-force cancellation of a running task
             ctypes.pythonapi.PyThreadState_SetAsyncExc(

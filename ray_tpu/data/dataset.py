@@ -164,6 +164,91 @@ class Dataset:
             for s in shards
         ]
 
+    def streaming_split(self, n: int) -> List[DataIterator]:
+        """n iterators fed CONCURRENTLY from ONE streaming execution,
+        each receiving a disjoint round-robin subset of blocks
+        (reference: Dataset.streaming_split over the output splitter,
+        data/_internal/execution/operators/output_splitter.py — the
+        per-Train-worker consumption pattern). Every split must be
+        consumed; an abandoned split eventually backpressures the pump
+        (bounded queues)."""
+        import queue as queue_mod
+        import threading
+
+        queues = [queue_mod.Queue(maxsize=4) for _ in builtins.range(n)]
+        DONE = object()
+
+        def pump():
+            try:
+                for pos, bundle in enumerate(self._stream_bundles()):
+                    queues[pos % n].put(bundle)
+            except BaseException as e:  # noqa: BLE001 — fan the error out
+                for q in queues:
+                    q.put(e)
+            finally:
+                for q in queues:
+                    q.put(DONE)
+
+        threading.Thread(
+            target=pump, name="streaming-split-pump", daemon=True
+        ).start()
+
+        class _Split:
+            def __init__(self, q):
+                self._q = q
+
+            def _stream_bundles(self):
+                while True:
+                    item = self._q.get()
+                    if item is DONE:
+                        return
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+
+        return [DataIterator(_Split(q)) for q in queues]
+
+    # -- write path (reference: Dataset.write_* over datasinks,
+    # data/_internal/datasource/*_datasink.py — one output file per
+    # block, written by distributed tasks; `path` must be visible to
+    # every node, e.g. shared storage, exactly like the reference) -----
+
+    def write_json(self, path: str) -> List[str]:
+        """One ndjson file per block (reference write_json)."""
+        return self._write(path, "json")
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(path, "csv")
+
+    def write_numpy(self, path: str) -> List[str]:
+        """One .npz per block holding the columnar batch."""
+        return self._write(path, "npy")
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(path, "parquet")
+
+    def _write(self, path: str, fmt: str) -> List[str]:
+        import os
+
+        from ray_tpu.core.api import get, remote
+
+        if fmt == "parquet":
+            try:
+                import pyarrow  # noqa: F401
+            except ImportError:
+                raise ImportError(
+                    "write_parquet requires pyarrow, which is not "
+                    "available in this image; use write_json/write_csv/"
+                    "write_numpy"
+                ) from None
+        os.makedirs(path, exist_ok=True)
+        writer = remote(_write_block)
+        refs = []
+        for pos, (ref, _meta) in enumerate(self._stream_bundles()):
+            out = os.path.join(path, f"part-{pos:05d}.{_EXT[fmt]}")
+            refs.append(writer.remote(ref, out, fmt))
+        return get(refs)
+
     def num_blocks(self) -> int:
         return sum(1 for _ in self._stream_bundles())
 
@@ -185,6 +270,54 @@ class Dataset:
 # ---------------------------------------------------------------------------
 # constructors (parity: python/ray/data/read_api.py)
 # ---------------------------------------------------------------------------
+
+
+_EXT = {"json": "jsonl", "csv": "csv", "npy": "npz", "parquet": "parquet"}
+
+
+def _write_block(block, out_path: str, fmt: str) -> str:
+    """Executor-side: persist one block as one file (the distributed
+    write task the reference's datasinks run per block)."""
+    acc = BlockAccessor.for_block(block)
+    if fmt == "json":
+        import json as json_mod
+
+        with open(out_path, "w") as f:
+            for row in acc.iter_rows():
+                f.write(json_mod.dumps(row, default=_jsonable) + "\n")
+    elif fmt == "csv":
+        import csv as csv_mod
+
+        rows = list(acc.iter_rows())
+        with open(out_path, "w", newline="") as f:
+            if rows and isinstance(rows[0], dict):
+                w = csv_mod.DictWriter(f, fieldnames=list(rows[0]))
+                w.writeheader()
+                w.writerows(rows)
+            else:
+                w = csv_mod.writer(f)
+                w.writerows([r] if not isinstance(r, (list, tuple)) else r
+                            for r in rows)
+    elif fmt == "npy":
+        batch = acc.to_batch()
+        np.savez(out_path, **{str(k): v for k, v in batch.items()})
+    elif fmt == "parquet":
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        batch = acc.to_batch()
+        pq.write_table(pa.table(dict(batch)), out_path)
+    else:
+        raise ValueError(f"unknown write format {fmt!r}")
+    return out_path
+
+
+def _jsonable(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    raise TypeError(f"not JSON serializable: {type(v)}")
 
 
 def range(n: int, *, parallelism: int = 4) -> Dataset:  # noqa: A001

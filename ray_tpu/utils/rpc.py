@@ -290,6 +290,11 @@ class RpcServer:
                     logger.warning("%s: unexpected message kind %r", self.name, kind)
         except (ConnectionError, OSError):
             pass
+        except KeyboardInterrupt:
+            # stray cancel interrupt on a reused thread ident: tear the
+            # connection down cleanly (callers retry on conn loss) rather
+            # than spewing an unhandled-thread traceback
+            pass
         finally:
             conn.alive = False
             with self._conns_lock:
@@ -313,10 +318,16 @@ class RpcServer:
             ok, payload = True, result
         except KeyboardInterrupt:
             # a cancel interrupt aimed at a task that already finished can
-            # land in this (per-request) dispatch thread: answer with a
-            # retryable error instead of dying reply-less
-            ok = False
-            payload = RemoteError("KeyboardInterrupt: stray cancel", "")
+            # land in this (per-request) dispatch thread: drop the
+            # connection — conn loss is the one failure every owner-side
+            # ladder classifies as retryable (a RemoteError reply would
+            # read as a permanent app failure)
+            conn.alive = False
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+            return
         except Exception as e:  # noqa: BLE001 — faithfully forward any error
             ok = False
             payload = RemoteError(
@@ -341,6 +352,7 @@ class RpcServer:
                 conn.sock.close()
             except OSError:
                 pass
+            return
 
 
 # ---------------------------------------------------------------------------
