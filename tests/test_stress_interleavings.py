@@ -1,0 +1,152 @@
+"""Concurrency stress harness for the borrow/lease/cancel protocols.
+
+Parity rationale: the reference runs TSAN/ASAN over its C++ runtime in
+CI (.bazelrc). Python has no thread sanitizer, so this file plays that
+role the way the runtime can be exercised: many client threads driving
+the exact protocols where interleaving bugs live (lease caching,
+cancellation racing completion, actor churn against the scheduler,
+chaos-injected RPC failures), with invariants checked at the end —
+no wedged cluster, no lost results, no resource leaks."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.exceptions import TaskCancelledError
+from ray_tpu.utils.config import config
+
+
+@pytest.fixture()
+def rt():
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_cancel_races_completion_storm(rt):
+    """Hammer cancel() against tasks that are just finishing: every task
+    must terminate as either its value or TaskCancelledError — never a
+    hang, never a stray-interrupt failure of an INNOCENT later task."""
+    @ray_tpu.remote
+    def quick(i):
+        time.sleep(0.002)
+        return i
+
+    outcomes = {"value": 0, "cancelled": 0, "other": []}
+    lock = threading.Lock()
+
+    def wave(seed):
+        for i in range(30):
+            ref = quick.remote(i)
+            if (i + seed) % 3 == 0:
+                # race the cancel against natural completion
+                time.sleep(0.001)
+                ray_tpu.cancel(ref)
+            try:
+                v = ray_tpu.get(ref, timeout=60)
+                assert v == i
+                with lock:
+                    outcomes["value"] += 1
+            except TaskCancelledError:
+                with lock:
+                    outcomes["cancelled"] += 1
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    outcomes["other"].append(repr(e))
+
+    threads = [
+        threading.Thread(target=wave, args=(s,)) for s in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    assert not outcomes["other"], outcomes["other"]
+    assert outcomes["value"] + outcomes["cancelled"] == 120
+    # the cluster still serves new work afterwards
+    assert ray_tpu.get(quick.remote(7), timeout=60) == 7
+
+
+def test_lease_cache_survives_chaos(rt):
+    """Chaos-injected lease_worker failures while multiple threads
+    submit: the lease cache's retry/backoff paths must deliver every
+    result exactly once."""
+    config.set("testing_rpc_failure", "lease_worker:0.2:0.2")
+    try:
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        results = {}
+        lock = threading.Lock()
+
+        def submitter(base):
+            refs = [double.remote(base + i) for i in range(40)]
+            vals = ray_tpu.get(refs, timeout=180)
+            with lock:
+                results[base] = vals
+
+        threads = [
+            threading.Thread(target=submitter, args=(b,))
+            for b in (0, 1000, 2000)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240)
+        for base, vals in results.items():
+            assert vals == [(base + i) * 2 for i in range(40)]
+        assert len(results) == 3
+    finally:
+        config.set("testing_rpc_failure", "")
+
+
+def test_actor_churn_with_concurrent_tasks(rt):
+    """Actors created/killed in a loop while normal tasks flow: the
+    scheduler's capacity accounting must converge — after the storm the
+    full CPU capacity is usable again."""
+    @ray_tpu.remote(num_cpus=1)
+    class Ephemeral:
+        def ping(self):
+            return 1
+
+    @ray_tpu.remote
+    def work(i):
+        return i
+
+    stop = threading.Event()
+    task_err = []
+
+    def task_flow():
+        i = 0
+        while not stop.is_set():
+            try:
+                assert ray_tpu.get(work.remote(i), timeout=60) == i
+            except Exception as e:  # noqa: BLE001
+                task_err.append(repr(e))
+                return
+            i += 1
+
+    flow = threading.Thread(target=task_flow)
+    flow.start()
+    try:
+        for _ in range(10):
+            actors = [Ephemeral.remote() for _ in range(4)]
+            assert ray_tpu.get(
+                [a.ping.remote() for a in actors], timeout=120
+            ) == [1] * 4
+            for a in actors:
+                ray_tpu.kill(a)
+    finally:
+        stop.set()
+        flow.join(60)
+    assert not task_err, task_err
+    # capacity converged: 8 one-CPU actors fit simultaneously again
+    final = [Ephemeral.remote() for _ in range(8)]
+    assert ray_tpu.get(
+        [a.ping.remote() for a in final], timeout=120
+    ) == [1] * 8
+    for a in final:
+        ray_tpu.kill(a)
