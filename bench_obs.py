@@ -1,0 +1,159 @@
+"""Observability overhead benchmark.
+
+Measures the canonical hot-path workload (tasks_async_batch40, same as
+bench_core.py) with tracing+core-metrics ON vs OFF, each in a fresh
+subprocess so the RT_TRACE_EVENTS / RT_OBSERVABILITY_ENABLED kill
+switches apply to every process in the cluster (driver, daemons, and
+spawned workers all read them at import).
+
+Also microbenchmarks the DISABLED guard itself (the single module-flag
+check every instrumented site pays when observability is off) and
+asserts the estimated per-task cost of those guards is <1% of the
+measured per-task latency — the contract that instrumentation can never
+silently regress the hot path when switched off.
+
+Run: python bench_obs.py  → one JSON object per line, plus BENCH_OBS.json.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# Worst-case count of flag checks one task pays on the owner+executor
+# when observability is OFF: submit stamp, dispatch stamp, exec stamp,
+# lease-cache counter, per-RPC client stamps (send+recv, ~2 RPCs/task
+# without batching), sched/lease-side guards. Deliberately generous.
+GUARD_CHECKS_PER_TASK = 16
+
+
+def _measure_batch40() -> float:
+    """tasks_async_batch40 (bench_core.py parity): returns tasks/s."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=32)
+
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    def batch_async():
+        ray_tpu.get([nop.remote() for _ in range(40)])
+
+    for _ in range(8):
+        batch_async()
+    # best-of-5 windows: a 1-core CI box schedules daemons mid-window,
+    # and a single sample can read 40% low on pure noise
+    best = 0.0
+    for _ in range(5):
+        n = 8
+        t0 = time.perf_counter()
+        for _ in range(n):
+            batch_async()
+        dt = time.perf_counter() - t0
+        best = max(best, 40 * n / dt)
+    ray_tpu.shutdown()
+    return best
+
+
+def _run_mode(mode: str) -> float:
+    env = dict(os.environ)
+    flag = "1" if mode == "on" else "0"
+    env["RT_TRACE_EVENTS"] = flag
+    env["RT_OBSERVABILITY_ENABLED"] = flag
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--mode", mode],
+        env=env, capture_output=True, text=True, timeout=300, check=True,
+    )
+    for line in out.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("metric") == "tasks_async_batch40":
+            return float(rec["value"])
+    raise RuntimeError(f"no metric line in {mode} run:\n{out.stdout}\n{out.stderr}")
+
+
+def _guard_cost_ns() -> float:
+    """Per-check cost of the disabled-path guard (one module attribute
+    read + branch), measured against an empty loop baseline."""
+    from ray_tpu.observability import core_metrics, tracing
+
+    tracing.set_enabled(False)
+    core_metrics.set_enabled(False)
+    try:
+        n = 2_000_000
+        hits = 0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if tracing.ENABLED:
+                hits += 1
+            if core_metrics.ENABLED:
+                hits += 1
+        guarded = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pass
+        baseline = time.perf_counter() - t0
+        assert hits == 0
+        return max(guarded - baseline, 0.0) / (2 * n) * 1e9
+    finally:
+        tracing.set_enabled(True)
+        core_metrics.set_enabled(True)
+
+
+def main() -> int:
+    if "--mode" in sys.argv:
+        per_s = _measure_batch40()
+        print(json.dumps({
+            "metric": "tasks_async_batch40",
+            "value": round(per_s, 1),
+            "unit": "tasks/s",
+        }), flush=True)
+        return 0
+
+    results = {}
+
+    def record(name, value, unit):
+        results[name] = {"value": value, "unit": unit}
+        print(json.dumps({"metric": name, "value": value, "unit": unit}),
+              flush=True)
+
+    off = _run_mode("off")
+    on = _run_mode("on")
+    record("tasks_async_batch40_trace_off", round(off, 1), "tasks/s")
+    record("tasks_async_batch40_trace_on", round(on, 1), "tasks/s")
+    record(
+        "tracing_on_overhead_pct",
+        round((off / on - 1.0) * 100.0, 2) if on else 0.0,
+        "%",
+    )
+
+    guard_ns = _guard_cost_ns()
+    record("disabled_guard_cost_ns", round(guard_ns, 2), "ns/check")
+    per_task_s = 1.0 / off
+    off_overhead_pct = (
+        GUARD_CHECKS_PER_TASK * guard_ns * 1e-9 / per_task_s * 100.0
+    )
+    record("tracing_off_overhead_pct", round(off_overhead_pct, 4), "%")
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_OBS.json"), "w") as f:
+        json.dump(results, f, indent=2)
+
+    # The hard contract: with the kill switch off, the instrumented path
+    # must cost (estimated, worst-case guard count) under 1% of a task.
+    assert off_overhead_pct < 1.0, (
+        f"tracing-off guard overhead {off_overhead_pct:.3f}% >= 1% "
+        f"({guard_ns:.1f}ns/check x {GUARD_CHECKS_PER_TASK} checks at "
+        f"{per_task_s * 1e6:.1f}us/task)"
+    )
+    print(json.dumps({"ok": True, "off_overhead_pct": round(off_overhead_pct, 4)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

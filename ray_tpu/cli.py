@@ -48,7 +48,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     tl = sub.add_parser("timeline", help="dump a Chrome-trace timeline")
     tl.add_argument("--out", default="timeline.json")
-    sub.add_parser("metrics", help="aggregated user metrics (Prometheus text)")
+    sub.add_parser(
+        "summary",
+        help="per-task queue-wait / exec latency percentiles",
+    )
+    sub.add_parser("metrics", help="aggregated metrics (Prometheus text)")
     dash = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     dash.add_argument("--port", type=int, default=8265)
     dash.add_argument(
@@ -118,6 +122,39 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "timeline":
         path = state.timeline(addr, out_path=args.out)
         print(f"wrote {path} (open in chrome://tracing or ui.perfetto.dev)")
+        return 0
+    if args.cmd == "summary":
+        summary = state.task_summary(addr)
+        if args.as_json:
+            print(json.dumps(summary, indent=2))
+            return 0
+        rows = []
+        for name, entry in summary["tasks"].items():
+            qw = entry.get("queue_wait_s")
+            ex = entry["exec_s"]
+
+            def ms(v):
+                return f"{v * 1e3:.2f}"
+
+            rows.append({
+                "name": name,
+                "count": entry["count"],
+                "queue_p50_ms": ms(qw["p50"]) if qw else "-",
+                "queue_p95_ms": ms(qw["p95"]) if qw else "-",
+                "queue_p99_ms": ms(qw["p99"]) if qw else "-",
+                "exec_p50_ms": ms(ex["p50"]),
+                "exec_p95_ms": ms(ex["p95"]),
+                "exec_p99_ms": ms(ex["p99"]),
+            })
+        print(_fmt_table(rows, [
+            "name", "count", "queue_p50_ms", "queue_p95_ms",
+            "queue_p99_ms", "exec_p50_ms", "exec_p95_ms", "exec_p99_ms",
+        ]))
+        if summary["events_dropped"]:
+            print(
+                f"warning: {summary['events_dropped']} events dropped from "
+                f"bounded buffers — percentiles cover a truncated window"
+            )
         return 0
     if args.cmd == "metrics":
         from ray_tpu.utils import metrics as metrics_mod

@@ -27,6 +27,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import scheduling
+from ray_tpu.observability import core_metrics
 from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.utils.rpc import ClientPool, RpcError, RpcServer
@@ -405,6 +406,17 @@ class ControlStore:
         self._mark_node_dead(node_id, "drained")
         return True
 
+    def rpc_get_metrics(self, conn):
+        """This process's metric registry (built-in scheduler series live
+        here). The token lets state.cluster_metrics dedup the head case
+        where control store + agent + driver share one process."""
+        from ray_tpu.utils import metrics as metrics_mod
+
+        return {
+            "token": metrics_mod.PROCESS_TOKEN,
+            "metrics": metrics_mod.snapshot_all(),
+        }
+
     def _public_node(self, node_id: str) -> Dict[str, Any]:
         n = self._nodes[node_id]
         return {
@@ -548,7 +560,12 @@ class ControlStore:
     # -- the GCS io-service; one dispatcher, async RPC continuations) ----
 
     def _sched_enqueue(self, item: tuple) -> None:
-        self._sched_q.put(item)
+        # queue entries carry their enqueue time so the dispatcher can
+        # report queue-wait (rt_sched_dispatch_latency_s) — the "which
+        # queue is the bottleneck" signal at pod scale
+        self._sched_q.put((time.monotonic(), item))
+        if core_metrics.ENABLED:
+            core_metrics.sched_queue_depth.set(self._sched_q.qsize())
 
     def _sched_retry(self, item: tuple, key: tuple) -> None:
         """Re-enqueue after this key's (exponential, capped) backoff.
@@ -585,22 +602,30 @@ class ControlStore:
                         0.05, self._sched_backoff[key] / 2
                     )
         for it in items:
-            self._sched_q.put(it)
+            self._sched_enqueue(it)
 
     def _sched_loop(self) -> None:
         while not self._stopped.is_set():
             now = time.monotonic()
+            ready = []
             with self._sched_retry_lock:
                 while self._sched_retries and self._sched_retries[0][0] <= now:
                     _, _, item = heapq.heappop(self._sched_retries)
-                    self._sched_q.put(item)
+                    ready.append(item)
                 timeout = 0.5
                 if self._sched_retries:
                     timeout = min(timeout, self._sched_retries[0][0] - now)
+            for item in ready:
+                self._sched_enqueue(item)
             try:
-                item = self._sched_q.get(timeout=max(timeout, 0.005))
+                enq_ts, item = self._sched_q.get(timeout=max(timeout, 0.005))
             except queue.Empty:
                 continue
+            if core_metrics.ENABLED:
+                core_metrics.sched_queue_depth.set(self._sched_q.qsize())
+                core_metrics.sched_dispatch_latency_s.observe(
+                    time.monotonic() - enq_ts, tags={"kind": str(item[0])}
+                )
             try:
                 self._process_sched(item)
             except Exception:  # noqa: BLE001 — scheduler must survive
@@ -686,7 +711,7 @@ class ControlStore:
             self._sched_retry(("actor", actor_id), ("actor", actor_id))
             return
         pend.add_done_callback(
-            lambda p: self._sched_q.put(
+            lambda p: self._sched_enqueue(
                 ("actor_lease", actor_id, node_id, agent_addr, p)
             )
         )
@@ -732,7 +757,7 @@ class ControlStore:
             self._sched_retry(("actor", actor_id), ("actor", actor_id))
             return
         pend2.add_done_callback(
-            lambda p: self._sched_q.put(
+            lambda p: self._sched_enqueue(
                 ("actor_created", actor_id, node_id, agent_addr, lease, p)
             )
         )

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import scheduling
 from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.observability import core_metrics
 from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import NodeID
 from ray_tpu.utils.rpc import RpcClient, RpcError, RpcServer
@@ -305,6 +306,19 @@ class NodeAgent:
     def rpc_get_data_port(self, conn):
         return self.data_port
 
+    def _update_pool_gauge_locked(self) -> None:
+        """Refresh rt_worker_pool_size{state=...,node=...} from the live
+        pool."""
+        counts: Dict[str, int] = {"idle": 0, "leased": 0, "dead": 0}
+        for w in self._workers.values():
+            counts[w.state] = counts.get(w.state, 0) + 1
+        counts["spawning"] = self._pending_spawns
+        node = self.node_id.hex()[:8]
+        for state, n in counts.items():
+            core_metrics.worker_pool_size.set(
+                n, tags={"state": state, "node": node}
+            )
+
     def _heartbeat_loop(self) -> None:
         # Versioned resource-view sync (reference ray_syncer.h:91 delta
         # protocol): a heartbeat carries the full resource payload only
@@ -317,6 +331,8 @@ class NodeAgent:
         since_full = 0
         while not self._stopped.wait(config.health_check_period_s):
             with self._lock:
+                if core_metrics.ENABLED:
+                    self._update_pool_gauge_locked()
                 avail = dict(self.resources_available)
                 pending = self._pending_leases
                 busy = len(self._leases)
@@ -566,6 +582,8 @@ class NodeAgent:
         transient store->agent reconnect must NOT kill every actor on the
         node."""
         resources = {k: float(v) for k, v in (resources or {}).items() if v}
+        if core_metrics.ENABLED:
+            core_metrics.lease_requests.inc()
         # Cluster-level decision: can/should this run here? (spillback)
         if bundle is None:
             target = self._pick_target_node(resources, strategy)
@@ -665,6 +683,8 @@ class NodeAgent:
                         # liveness check through this insert, and the reap
                         # scan (_owner_conn_closed) needs the same lock —
                         # a disconnect after the check reaps post-insert
+                        if core_metrics.ENABLED:
+                            core_metrics.lease_grants.inc()
                         return {
                             "granted": True,
                             "worker_address": worker.address,
@@ -1080,8 +1100,21 @@ class NodeAgent:
     # introspection (state API backing)
     # ------------------------------------------------------------------
 
+    def rpc_get_metrics(self, conn):
+        """This process's metric registry (lease/pool/object-store series
+        for a standalone agent; on the head this is the same registry the
+        driver serves — state.cluster_metrics dedups by token)."""
+        from ray_tpu.utils import metrics as metrics_mod
+
+        return {
+            "token": metrics_mod.PROCESS_TOKEN,
+            "metrics": metrics_mod.snapshot_all(),
+        }
+
     def rpc_get_state(self, conn):
         with self._lock:
+            if core_metrics.ENABLED:
+                self._update_pool_gauge_locked()
             return {
                 "node_id": self.node_id.hex(),
                 "address": self.address,

@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu.core.exceptions import ObjectLostError
+from ray_tpu.observability import core_metrics
 from ray_tpu.utils.ids import ObjectID
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
@@ -82,9 +83,21 @@ class ShmObjectStore:
         self._capacity = capacity_bytes
         self._used = 0
         self._spilled_bytes = 0
+        # label for the per-node store gauges (cluster merge keeps the
+        # latest value PER SERIES; distinct node tags keep every node)
+        self._node_tag = node_id_hex[:8]
         self._lock = threading.Lock()
         self._sealed_cv = threading.Condition(self._lock)
         self._objects: Dict[str, _Entry] = {}
+
+    def _publish_gauges_locked(self) -> None:
+        """Refresh the built-in store gauges; call sites hold the lock and
+        guard on core_metrics.ENABLED."""
+        tags = {"node": self._node_tag}
+        core_metrics.object_store_used_bytes.set(self._used, tags=tags)
+        core_metrics.object_store_spilled_bytes.set(
+            self._spilled_bytes, tags=tags
+        )
 
     # -- spill machinery -------------------------------------------------
 
@@ -132,6 +145,9 @@ class ShmObjectStore:
             e.state = "spilled"
             self._used -= e.size
             self._spilled_bytes += e.size
+            if core_metrics.ENABLED:
+                core_metrics.object_store_spills.inc()
+                self._publish_gauges_locked()
             self._sealed_cv.notify_all()
 
     def _ensure_room_locked(self, size: int) -> None:
@@ -199,6 +215,9 @@ class ShmObjectStore:
         e.spill_path = None
         e.state = "shm"
         self._spilled_bytes -= e.size
+        if core_metrics.ENABLED:
+            core_metrics.object_store_restores.inc()
+            self._publish_gauges_locked()
         self._sealed_cv.notify_all()
 
     # -- public API ------------------------------------------------------
@@ -234,6 +253,8 @@ class ShmObjectStore:
                 self._objects.pop(oid_hex, None)
                 self._used -= size
                 raise
+            if core_metrics.ENABLED:
+                self._publish_gauges_locked()
         for p in drop_paths:
             try:
                 os.unlink(p)
@@ -296,6 +317,8 @@ class ShmObjectStore:
                 self._used -= entry.size
             else:
                 self._spilled_bytes -= entry.size
+            if core_metrics.ENABLED:
+                self._publish_gauges_locked()
         for p in (entry.path if entry.in_shm else None, entry.spill_path):
             if p:
                 try:

@@ -51,6 +51,7 @@ from ray_tpu.core.object_store import (
     _pwrite_all,
 )
 from ray_tpu.core.task import TaskOptions, TaskSpec
+from ray_tpu.observability import core_metrics, tracing
 from ray_tpu.utils import serialization
 from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -455,8 +456,11 @@ class CoreWorker:
         self._actor_retry_cache: Dict[str, int] = {}
         # task execution events for the timeline (reference
         # task_event_buffer.cc -> GcsTaskManager -> `ray timeline`):
-        # bounded ring of {name, task_id, ts_us, dur_us, status}
+        # bounded ring of execution slices {name, task_id, ts_us, dur_us}
+        # plus lifecycle instants (observability/tracing.py). Evictions
+        # are counted so a truncated timeline is detectable.
         self._task_events: deque = deque(maxlen=10000)
+        self._task_events_dropped = 0
 
     # ------------------------------------------------------------------
     # identity / context
@@ -1275,6 +1279,10 @@ class CoreWorker:
             ):
                 _, dropped = self._lineage.popitem(last=False)
                 self._lineage_bytes -= len(dropped[0].args_frame)
+        if tracing.ENABLED:
+            self._append_task_event(tracing.lifecycle_event(
+                tracing.SUBMITTED, task_id.hex(), spec.name, self.address,
+            ))
         pending_deps = self._pending_arg_deps(args, kwargs)
         if pending_deps:
             # The task must not compete for a worker lease until every
@@ -1882,6 +1890,10 @@ class CoreWorker:
             name=f"{actor_id[:8]}.{method_name}",
             tensor_transport=tensor_transport,
         )
+        if tracing.ENABLED:
+            self._append_task_event(tracing.lifecycle_event(
+                tracing.SUBMITTED, task_id.hex(), spec.name, self.address,
+            ))
         pending_deps = self._pending_arg_deps(args, kwargs)
         if pending_deps:
             # awaited by the sender thread just before the send — ordered
@@ -2089,15 +2101,16 @@ class CoreWorker:
                         cause=e,
                     ),
                 }
-            self._task_events.append({
-                "name": spec.name or spec.method_name,
-                "task_id": spec.task_id.hex(),
-                "actor_id": spec.actor_id,
-                "ts_us": int(_t0 * 1e6),
-                "dur_us": int((time.time() - _t0) * 1e6),
-                "worker": self.address,
-                "pid": os.getpid(),
-            })
+            if tracing.ENABLED:
+                self._append_task_event({
+                    "name": spec.name or spec.method_name,
+                    "task_id": spec.task_id.hex(),
+                    "actor_id": spec.actor_id,
+                    "ts_us": int(_t0 * 1e6),
+                    "dur_us": int((time.time() - _t0) * 1e6),
+                    "worker": self.address,
+                    "pid": os.getpid(),
+                })
             RpcServer.reply(conn, req_id, True, reply)
 
         # the reply path serializes results and makes plasma RPCs — hand
@@ -2245,26 +2258,45 @@ class CoreWorker:
         finally:
             self._running_tasks.pop(spec.task_id.hex(), None)
             self._current_ctx.task_id = None
-            self._task_events.append({
-                "name": spec.name or spec.fn_name,
-                "task_id": spec.task_id.hex(),
-                "actor_id": spec.actor_id,
-                "ts_us": int(_t0 * 1e6),
-                "dur_us": int((time.time() - _t0) * 1e6),
-                "worker": self.address,
-                "pid": os.getpid(),
-            })
+            if tracing.ENABLED:
+                self._append_task_event({
+                    "name": spec.name or spec.fn_name,
+                    "task_id": spec.task_id.hex(),
+                    "actor_id": spec.actor_id,
+                    "ts_us": int(_t0 * 1e6),
+                    "dur_us": int((time.time() - _t0) * 1e6),
+                    "worker": self.address,
+                    "pid": os.getpid(),
+                })
+
+    def _append_task_event(self, evt: Dict[str, Any]) -> None:
+        """Append to the bounded event ring, counting silent evictions —
+        a full ring drops the OLDEST event, so long runs would otherwise
+        truncate their timelines undetectably."""
+        ring = self._task_events
+        if len(ring) == ring.maxlen:
+            self._task_events_dropped += 1
+            if core_metrics.ENABLED:
+                core_metrics.task_events_dropped.inc()
+        ring.append(evt)
 
     def rpc_get_task_events(self, conn, clear: bool = False):
         events = list(self._task_events)
+        dropped = self._task_events_dropped
         if clear:
+            # window semantics: clearing starts a fresh window, so the
+            # drop count must restart with it
             self._task_events.clear()
-        return events
+            self._task_events_dropped = 0
+        return {"events": events, "dropped": dropped}
 
     def rpc_get_metrics(self, conn):
         from ray_tpu.utils import metrics as metrics_mod
 
-        return metrics_mod.snapshot_all()
+        return {
+            "token": metrics_mod.PROCESS_TOKEN,
+            "metrics": metrics_mod.snapshot_all(),
+        }
 
     def _resolve_arg(self, value: Any) -> Any:
         if isinstance(value, ObjectRef):
@@ -2815,7 +2847,7 @@ class _Lease:
     """A granted worker lease held by the owner's lease cache."""
 
     __slots__ = ("agent_addr", "worker_addr", "lease_id", "idle_since",
-                 "client")
+                 "client", "fresh")
 
     def __init__(self, agent_addr: str, worker_addr: str, lease_id: str):
         self.agent_addr = agent_addr
@@ -2823,6 +2855,9 @@ class _Lease:
         self.lease_id = lease_id
         self.idle_since = time.monotonic()
         self.client = None  # RpcClient, bound at first dispatch
+        # True until the first dispatch: that one task paid the lease
+        # RPC, every later one is a cache hit (rt_lease_cache_hits_total)
+        self.fresh = True
 
 
 class _NormalTaskSubmitter:
@@ -2955,6 +2990,20 @@ class _NormalTaskSubmitter:
             for spec in specs:
                 w._inflight_push[spec.task_id.hex()] = lease.worker_addr
                 self._dispatch_ts[spec.task_id.hex()] = now
+        if core_metrics.ENABLED:
+            # tasks that rode an ALREADY-PAID-FOR lease: the first
+            # dispatch on a fresh grant is the one task its lease RPC
+            # bought, every other is a cache hit
+            hits = len(specs) - (1 if lease.fresh else 0)
+            if hits:
+                core_metrics.lease_cache_hits.inc(hits)
+        lease.fresh = False
+        if tracing.ENABLED:
+            for spec in specs:
+                w._append_task_event(tracing.lifecycle_event(
+                    tracing.DISPATCHED, spec.task_id.hex(), spec.name,
+                    w.address, target=lease.worker_addr,
+                ))
         try:
             client = lease.client
             if client is None:
@@ -3368,6 +3417,12 @@ class _NormalTaskSubmitter:
                         granted.client.connect()
                     except RpcError:
                         pass  # dispatch's failure path handles it
+                    if tracing.ENABLED:
+                        w._append_task_event(tracing.lifecycle_event(
+                            tracing.LEASE_GRANTED, granted.lease_id,
+                            "lease", w.address,
+                            target=granted.worker_addr,
+                        ))
                     self._on_lease(granted)
                     return
                 spill = lease.get("spillback")

@@ -37,7 +37,7 @@ _PAGE = """<!doctype html>
 <h2>actors</h2>{actors}
 <h2>jobs</h2>{jobs}
 <p>APIs: /api/status /api/nodes /api/actors /api/jobs /api/workers
-/api/placement_groups /api/timeline /metrics</p>
+/api/placement_groups /api/timeline /api/task_summary /metrics</p>
 </body></html>"""
 
 
@@ -139,6 +139,7 @@ class Dashboard:
             "/api/workers": lambda: state.list_workers(addr),
             "/api/placement_groups": lambda: state.list_placement_groups(addr),
             "/api/timeline": lambda: state.timeline(addr),
+            "/api/task_summary": lambda: state.task_summary(addr),
         }
         if path in apis:
             return (

@@ -1,0 +1,20 @@
+"""Runtime self-instrumentation (reference C18: the built-in core
+metrics the reference exports through OpenCensus → dashboard-agent →
+Prometheus, plus task lifecycle state tracking for `ray timeline` and
+the state API).
+
+Two subsystems, two kill switches, both read ONCE into module-level
+flags so a disabled hot path pays a single attribute check:
+
+- ``core_metrics`` — built-in Counter/Gauge/Histogram series wired into
+  the scheduler, lease, object-store, RPC, and serve hot paths.
+  Disabled with ``RT_OBSERVABILITY_ENABLED=0``.
+- ``tracing`` — task lifecycle span stamping (submit / lease-granted /
+  dispatched on the owner; start/end execution slices on the executor)
+  feeding ``state.timeline()`` flow events and ``state.task_summary()``.
+  Disabled with ``RT_TRACE_EVENTS=0``.
+"""
+
+from ray_tpu.observability import core_metrics, tracing  # noqa: F401
+
+__all__ = ["core_metrics", "tracing"]

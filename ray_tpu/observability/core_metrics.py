@@ -1,0 +1,147 @@
+"""Built-in core metrics for the runtime's own hot paths.
+
+Parity target: the reference's ~100 built-in metrics (ray_metric_defs,
+exported via OpenCensus → dashboard agent → Prometheus). Instruments
+register in the ordinary per-process registry (utils/metrics.py), so
+``state.cluster_metrics`` / the dashboard's ``/metrics`` aggregate them
+across every process exactly like user metrics — no second pipeline.
+
+Hot-path contract: callers guard every update with the module-level
+``ENABLED`` flag (``if core_metrics.ENABLED: core_metrics.X...``), never
+a registry lookup, so ``RT_OBSERVABILITY_ENABLED=0`` reduces the whole
+subsystem to one attribute check per site.
+
+Import discipline: this module may import only ``ray_tpu.utils.*`` —
+it is imported from the RPC substrate itself.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.utils.config import config
+from ray_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    register_reset_hook,
+)
+
+ENABLED = bool(config.observability_enabled)
+
+# Latency instruments get sub-millisecond-resolution buckets: the core
+# plane's interesting range is 10us..1s (an RPC roundtrip is ~100us).
+_LATENCY_BOUNDS = (
+    0.00001, 0.00005, 0.0001, 0.0005, 0.001, 0.005,
+    0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+)
+_BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _build() -> dict:
+    return {
+        # -- scheduler (control_store.py) --
+        "sched_queue_depth": Gauge(
+            "rt_sched_queue_depth",
+            "control-store scheduler queue depth (actors + PGs pending)",
+        ),
+        "sched_dispatch_latency_s": Histogram(
+            "rt_sched_dispatch_latency_s",
+            "time a scheduler item waits in the queue before processing",
+            boundaries=_LATENCY_BOUNDS,
+            tag_keys=("kind",),
+        ),
+        # -- leases (node_agent.py: agent side; worker.py: owner side) --
+        "lease_requests": Counter(
+            "rt_lease_requests_total",
+            "lease_worker RPCs received by this node agent",
+        ),
+        "lease_grants": Counter(
+            "rt_lease_grants_total",
+            "worker leases granted by this node agent",
+        ),
+        "lease_cache_hits": Counter(
+            "rt_lease_cache_hits_total",
+            "tasks dispatched onto an already-held (cached) worker lease "
+            "without a lease RPC",
+        ),
+        # per-node gauges carry a node label: the cluster merge keeps the
+        # LATEST value per series key, so unlabelled per-node gauges
+        # would collapse an N-node cluster to whichever agent answered
+        # last
+        "worker_pool_size": Gauge(
+            "rt_worker_pool_size",
+            "workers in this node agent's pool by state",
+            tag_keys=("state", "node"),
+        ),
+        # -- object store (object_store.py) --
+        "object_store_used_bytes": Gauge(
+            "rt_object_store_used_bytes",
+            "bytes of sealed+unsealed segments resident in shm",
+            tag_keys=("node",),
+        ),
+        "object_store_spilled_bytes": Gauge(
+            "rt_object_store_spilled_bytes",
+            "bytes currently spilled to disk",
+            tag_keys=("node",),
+        ),
+        "object_store_spills": Counter(
+            "rt_object_store_spill_total",
+            "segments spilled shm -> disk under memory pressure",
+        ),
+        "object_store_restores": Counter(
+            "rt_object_store_restore_total",
+            "segments restored disk -> shm for same-host readers",
+        ),
+        # -- RPC substrate (utils/rpc.py) --
+        "rpc_client_latency_s": Histogram(
+            "rt_rpc_client_latency_s",
+            "client-observed RPC round-trip latency by method family",
+            boundaries=_LATENCY_BOUNDS,
+            tag_keys=("family",),
+        ),
+        # -- serve (serve/router.py, serve/batching.py) --
+        "serve_router_requests": Counter(
+            "rt_serve_router_requests_total",
+            "requests routed by deployment",
+            tag_keys=("deployment",),
+        ),
+        "serve_router_queue_wait_s": Histogram(
+            "rt_serve_router_queue_wait_s",
+            "time a request waits in the router for a replica assignment",
+            boundaries=_LATENCY_BOUNDS,
+        ),
+        "serve_batch_size": Histogram(
+            "rt_serve_batch_size",
+            "@serve.batch executed batch sizes",
+            boundaries=_BATCH_BOUNDS,
+        ),
+        "serve_batch_wait_s": Histogram(
+            "rt_serve_batch_wait_s",
+            "time a request waits in a @serve.batch queue before its "
+            "batch executes",
+            boundaries=_LATENCY_BOUNDS,
+        ),
+        # -- task event buffer (worker.py) --
+        "task_events_dropped": Counter(
+            "rt_task_events_dropped_total",
+            "task lifecycle/execution events evicted from the bounded "
+            "per-worker ring buffer",
+        ),
+    }
+
+
+def _reinstall() -> None:
+    """(Re)create every instrument and rebind the module attributes.
+    Registered as a registry reset hook, so the runtime's
+    self-instrumentation survives test resets."""
+    for key, instrument in _build().items():
+        globals()[key] = instrument
+
+
+def set_enabled(on: bool) -> None:
+    global ENABLED
+    ENABLED = bool(on)
+    config.set("observability_enabled", bool(on))
+
+
+_reinstall()
+register_reset_hook(_reinstall)

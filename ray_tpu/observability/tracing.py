@@ -1,0 +1,62 @@
+"""Task lifecycle span stamping.
+
+Parity target: the reference's task state transitions
+(PENDING_ARGS_AVAIL → SUBMITTED_TO_WORKER → RUNNING → FINISHED) recorded
+by task_event_buffer.cc and surfaced through `ray timeline` / the state
+API. Here, owner-side lifecycle instants ("submitted", "lease_granted",
+"dispatched") and executor-side execution slices share one bounded ring
+per worker (CoreWorker._task_events); ``state.timeline()`` joins them by
+task_id into Chrome-trace flow events across pids and
+``state.task_summary()`` turns them into queue-wait / exec percentiles.
+
+Hot-path contract: callers guard with the module-level ``ENABLED`` flag
+(``if tracing.ENABLED: ...``) so ``RT_TRACE_EVENTS=0`` reduces every
+stamp site to one attribute check — no dict building, no time syscall.
+
+Import discipline: only ``ray_tpu.utils.*`` imports allowed here.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.utils.config import config
+
+ENABLED = bool(config.trace_events)
+
+# Lifecycle event phases (the "type": "lifecycle" events in the ring;
+# executor execution slices carry no "type" key — the legacy shape).
+SUBMITTED = "submitted"
+LEASE_GRANTED = "lease_granted"
+DISPATCHED = "dispatched"
+
+
+def set_enabled(on: bool) -> None:
+    global ENABLED
+    ENABLED = bool(on)
+    config.set("trace_events", bool(on))
+
+
+def lifecycle_event(
+    phase: str,
+    task_id: str,
+    name: str,
+    worker_address: str,
+    target: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Build one lifecycle instant. Callers append it to their worker's
+    event ring (CoreWorker._append_task_event)."""
+    evt = {
+        "type": "lifecycle",
+        "phase": phase,
+        "task_id": task_id,
+        "name": name,
+        "ts_us": int(time.time() * 1e6),
+        "worker": worker_address,
+        "pid": os.getpid(),
+    }
+    if target is not None:
+        evt["target"] = target
+    return evt

@@ -34,17 +34,20 @@ import threading
 import time
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.observability import core_metrics
+
 _LAZY_LOCK = threading.Lock()
 
 
 class _Item:
-    __slots__ = ("value", "event", "result", "error")
+    __slots__ = ("value", "event", "result", "error", "enq_ts")
 
     def __init__(self, value):
         self.value = value
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.enq_ts = time.monotonic()
 
 
 class _BatchQueue:
@@ -114,6 +117,11 @@ class _BatchQueue:
         self.batch_sizes.append(len(batch))
         if len(self.batch_sizes) > 100:
             del self.batch_sizes[:-100]
+        if core_metrics.ENABLED:
+            core_metrics.serve_batch_size.observe(len(batch))
+            now = time.monotonic()
+            for it in batch:
+                core_metrics.serve_batch_wait_s.observe(now - it.enq_ts)
         try:
             args = [it.value for it in batch]
             results = (

@@ -17,6 +17,7 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.core.actor import ActorHandle
+from ray_tpu.observability import core_metrics
 
 ROUTE_REFRESH_S = 1.0
 
@@ -102,7 +103,8 @@ class Router:
         for one model stays warm on one replica instead of thrashing
         every replica's LRU; when nobody holds it, normal pow-2 picks the
         replica that will load it."""
-        deadline = time.monotonic() + timeout_s
+        t0 = time.monotonic()
+        deadline = t0 + timeout_s
         while True:
             self._refresh()
             with self._lock:
@@ -129,6 +131,13 @@ class Router:
                     self._local_inflight[rid] = (
                         self._local_inflight.get(rid, 0) + 1
                     )
+                    if core_metrics.ENABLED:
+                        core_metrics.serve_router_requests.inc(
+                            tags={"deployment": deployment}
+                        )
+                        core_metrics.serve_router_queue_wait_s.observe(
+                            time.monotonic() - t0
+                        )
                     return rid, ActorHandle(*chosen["handle_info"])
             if time.monotonic() >= deadline:
                 raise TimeoutError(

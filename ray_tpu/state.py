@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from ray_tpu.utils.rpc import ClientPool, RpcConnectionError, RpcError
 
@@ -137,41 +137,102 @@ def cluster_status(address: Optional[str] = None) -> Dict[str, Any]:
     }
 
 
-def _worker_addresses(address: Optional[str]) -> List[str]:
+def _worker_addresses(
+    address: Optional[str],
+    agents: Optional[List[Dict[str, Any]]] = None,
+) -> List[str]:
+    if agents is None:
+        agents = _agent_states(address)
     addrs = []
-    for st in _agent_states(address):
+    for st in agents:
         for w in st.get("workers", {}).values():
             addrs.append(w["address"])
+    # drivers execute nothing but OWN events (submit/dispatch lifecycle
+    # instants) and metrics: reach them through the job registry so
+    # out-of-process consumers (rt summary, a standalone dashboard) see
+    # owner-side data, not just executor slices
+    try:
+        for job in list_jobs(address):
+            if job.get("alive") and job.get("driver_address"):
+                addrs.append(job["driver_address"])
+    except (RpcError, RuntimeError):
+        pass
     from ray_tpu.core import worker as worker_mod
 
     w = worker_mod.global_worker_or_none()
     if w is not None:
-        addrs.append(w.address)  # the driver executes nothing but owns events
-    return addrs
+        addrs.append(w.address)
+    # dedup (an in-process driver is also a live job) preserving order
+    return list(dict.fromkeys(addrs))
+
+
+def _collect_task_events(
+    address: Optional[str],
+) -> Tuple[List[Dict[str, Any]], int]:
+    """Gather every worker's event ring. Returns (events, dropped_total)
+    — dropped counts ring evictions, so a truncated timeline is
+    detectable instead of silently missing its head."""
+    events: List[Dict[str, Any]] = []
+    dropped = 0
+    for addr in _worker_addresses(address):
+        try:
+            reply = _pool.get(addr).call("get_task_events", timeout_s=10.0)
+        except RpcConnectionError:
+            _pool.drop(addr)
+            continue
+        except RpcError:
+            continue
+        if isinstance(reply, dict):
+            events.extend(reply.get("events", ()))
+            dropped += int(reply.get("dropped", 0))
+        else:  # legacy list shape
+            events.extend(reply)
+    return events, dropped
 
 
 def task_events(address: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Collect task execution events from every live worker."""
-    events: List[Dict[str, Any]] = []
-    for addr in _worker_addresses(address):
-        try:
-            events.extend(
-                _pool.get(addr).call("get_task_events", timeout_s=10.0)
-            )
-        except RpcConnectionError:
-            _pool.drop(addr)
-        except RpcError:
-            pass
-    return events
+    """Collect task execution + lifecycle events from every live worker."""
+    return _collect_task_events(address)[0]
 
 
 def timeline(address: Optional[str] = None,
              out_path: Optional[str] = None) -> Any:
     """Chrome-trace (chrome://tracing / perfetto) of task executions
-    (parity: `ray timeline`, reference scripts.py:2171)."""
+    (parity: `ray timeline`, reference scripts.py:2171).
+
+    Execution events render as "X" duration slices. Lifecycle events
+    (observability/tracing.py) add cross-process causality: each task
+    with a "submitted" instant on its owner and an execution slice on a
+    worker emits a flow arrow (``ph:"s"`` on the owner pid →
+    ``ph:"f"`` binding to the execution slice on the executor pid), plus
+    an owner-side "submit:" slice spanning submit → dispatch so the
+    arrow has a visible anchor."""
     events = task_events(address)
-    trace = [
-        {
+    trace: List[Dict[str, Any]] = []
+    exec_slices: Dict[str, Dict[str, Any]] = {}
+    submits: Dict[str, Dict[str, Any]] = {}
+    dispatches: Dict[str, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("type") == "lifecycle":
+            if e["phase"] == "submitted":
+                submits[e["task_id"]] = e
+            elif e["phase"] == "dispatched":
+                dispatches[e["task_id"]] = e
+            elif e["phase"] == "lease_granted":
+                # lease churn as thread-scoped instants: correlates pool
+                # growth with the queue spikes that caused it
+                trace.append({
+                    "name": f"lease_granted:{e.get('target', '')}",
+                    "cat": "lease",
+                    "ph": "i",
+                    "s": "t",
+                    "ts": e["ts_us"],
+                    "pid": e["worker"],
+                    "tid": e.get("pid", 0),
+                    "args": {"lease_id": e["task_id"]},
+                })
+            continue
+        slice_ev = {
             "name": e["name"],
             "cat": "actor_task" if e.get("actor_id") else "task",
             "ph": "X",
@@ -181,8 +242,40 @@ def timeline(address: Optional[str] = None,
             "tid": e.get("pid", 0),
             "args": {"task_id": e["task_id"]},
         }
-        for e in events
-    ]
+        trace.append(slice_ev)
+        exec_slices[e["task_id"]] = e
+    for task_id, sub in submits.items():
+        exec_e = exec_slices.get(task_id)
+        disp = dispatches.get(task_id)
+        # owner-side anchor slice: submit -> dispatch (or a 1us tick)
+        anchor_end = disp["ts_us"] if disp else sub["ts_us"] + 1
+        trace.append({
+            "name": f"submit:{sub['name']}",
+            "cat": "task_submit",
+            "ph": "X",
+            "ts": sub["ts_us"],
+            "dur": max(anchor_end - sub["ts_us"], 1),
+            "pid": sub["worker"],
+            "tid": sub.get("pid", 0),
+            "args": {"task_id": task_id},
+        })
+        if exec_e is None:
+            continue
+        flow = {
+            "name": sub["name"],
+            "cat": "task_flow",
+            "id": task_id,
+        }
+        trace.append({
+            **flow, "ph": "s", "ts": sub["ts_us"],
+            "pid": sub["worker"], "tid": sub.get("pid", 0),
+        })
+        trace.append({
+            # bp:"e" binds the flow end to the ENCLOSING slice — the
+            # execution "X" beginning at the same ts on this pid/tid
+            **flow, "ph": "f", "bp": "e", "ts": exec_e["ts_us"],
+            "pid": exec_e["worker"], "tid": exec_e.get("pid", 0),
+        })
     if out_path:
         with open(out_path, "w") as f:
             json.dump(trace, f)
@@ -190,50 +283,148 @@ def timeline(address: Optional[str] = None,
     return trace
 
 
-def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:
-    """Aggregate user metrics (utils/metrics.py) across all workers:
-    counters/histograms sum, gauges keep the latest per series."""
+def _percentiles(values: List[float]) -> Dict[str, float]:
+    vs = sorted(values)
+    n = len(vs)
+
+    def pick(q: float) -> float:
+        return vs[min(n - 1, int(q * n))]
+
+    return {
+        "p50": pick(0.50), "p95": pick(0.95), "p99": pick(0.99),
+        "mean": sum(vs) / n, "max": vs[-1],
+    }
+
+
+def task_summary(address: Optional[str] = None) -> Dict[str, Any]:
+    """Per-task-name latency summary joined across processes: queue wait
+    (owner "submitted" instant → executor slice start) and execution
+    time, each as p50/p95/p99/mean/max seconds. The "where does time go
+    between submit and run" view (reference `ray summary tasks`)."""
+    events, dropped = _collect_task_events(address)
+    submits: Dict[str, int] = {}
+    for e in events:
+        if e.get("type") == "lifecycle" and e["phase"] == "submitted":
+            submits[e["task_id"]] = e["ts_us"]
+    per_name: Dict[str, Dict[str, List[float]]] = {}
+    for e in events:
+        if e.get("type") == "lifecycle":
+            continue
+        rec = per_name.setdefault(
+            e["name"], {"queue_wait_s": [], "exec_s": []}
+        )
+        rec["exec_s"].append(e["dur_us"] / 1e6)
+        sub_ts = submits.get(e["task_id"])
+        if sub_ts is not None:
+            # clamp: submit/exec stamps come from different processes'
+            # wall clocks; sub-ms skew must not produce negative waits
+            rec["queue_wait_s"].append(max(e["ts_us"] - sub_ts, 0) / 1e6)
+    tasks = {}
+    for name, rec in sorted(per_name.items()):
+        entry: Dict[str, Any] = {"count": len(rec["exec_s"])}
+        entry["exec_s"] = _percentiles(rec["exec_s"])
+        if rec["queue_wait_s"]:
+            entry["queue_wait_s"] = _percentiles(rec["queue_wait_s"])
+        tasks[name] = entry
+    return {"tasks": tasks, "events_dropped": dropped}
+
+
+def _copy_metric(m: Dict) -> Dict:
+    """Deep-enough copy of one metric snapshot: the merge mutates series
+    state in place, and the caller's input must survive unchanged."""
+    series = {}
+    for k, v in m["series"].items():
+        series[k] = (
+            dict(v, buckets=list(v["buckets"])) if isinstance(v, dict) else v
+        )
+    return dict(m, series=series)
+
+
+def _merge_snapshot_into(merged: Dict[str, Dict], snap: Dict[str, Dict]) -> None:
+    """Merge one process's metric snapshot into the aggregate: counters
+    and histograms sum, gauges keep the latest per series."""
+    for name, m in snap.items():
+        cur = merged.get(name)
+        if cur is None:
+            # copy on adoption: later snapshots merge INTO this entry,
+            # and mutating the first process's reply in place would
+            # corrupt the caller's data (and double-count on re-merge)
+            merged[name] = _copy_metric(m)
+            continue
+        for k, v in m["series"].items():
+            if m["kind"] == "counter":
+                cur["series"][k] = cur["series"].get(k, 0.0) + v
+            elif m["kind"] == "gauge":
+                cur["series"][k] = v
+            else:  # histogram
+                if tuple(m.get("boundaries", ())) != tuple(
+                    cur.get("boundaries", ())
+                ):
+                    # divergent boundaries across workers: bucket-wise
+                    # merge would be meaningless and render a corrupt
+                    # Prometheus histogram (le="+Inf" < _count). Keep
+                    # count/sum, drop bucket detail for the metric.
+                    cur["boundaries"] = ()
+                    for st in cur["series"].values():
+                        st["buckets"] = []
+                prev = cur["series"].get(k)
+                if prev is None:
+                    cur["series"][k] = (
+                        v if cur.get("boundaries")
+                        else dict(v, buckets=[])
+                    )
+                else:
+                    prev["sum"] += v["sum"]
+                    prev["count"] += v["count"]
+                    prev["buckets"] = [
+                        a + b
+                        for a, b in zip(prev["buckets"], v["buckets"])
+                    ]
+
+
+def merge_metric_snapshots(
+    snapshots: Iterable[Dict[str, Dict]],
+) -> Dict[str, Dict]:
+    """Pure aggregation over per-process snapshot_all() dicts (exposed
+    for direct testing of the merge semantics)."""
     merged: Dict[str, Dict] = {}
-    for addr in _worker_addresses(address):
+    for snap in snapshots:
+        _merge_snapshot_into(merged, snap)
+    return merged
+
+
+def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:
+    """Aggregate metrics (utils/metrics.py) across the whole cluster —
+    every worker, every node agent, and the control store — so the
+    built-in core metrics (scheduler/lease/object-store series that live
+    in daemon processes) surface alongside user metrics. Replies carry a
+    per-process token: on the head, control store + agent + driver share
+    ONE process and must be counted once, not three times."""
+    addrs: List[str] = [a for a in [address] if a is not None]
+    if not addrs:
         try:
-            snap = _pool.get(addr).call("get_metrics", timeout_s=10.0)
+            addrs.append(_control(None).address)
+        except RuntimeError:
+            pass
+    agents = _agent_states(address)
+    addrs.extend(st["address"] for st in agents)
+    addrs.extend(_worker_addresses(address, agents=agents))
+    merged: Dict[str, Dict] = {}
+    seen_tokens = set()
+    for addr in addrs:
+        try:
+            reply = _pool.get(addr).call("get_metrics", timeout_s=10.0)
         except RpcConnectionError:
             _pool.drop(addr)
             continue
         except RpcError:
             continue
-        for name, m in snap.items():
-            cur = merged.get(name)
-            if cur is None:
-                merged[name] = m
+        if isinstance(reply, dict) and "metrics" in reply and "token" in reply:
+            token, snap = reply["token"], reply["metrics"]
+            if token in seen_tokens:
                 continue
-            for k, v in m["series"].items():
-                if m["kind"] == "counter":
-                    cur["series"][k] = cur["series"].get(k, 0.0) + v
-                elif m["kind"] == "gauge":
-                    cur["series"][k] = v
-                else:  # histogram
-                    if tuple(m.get("boundaries", ())) != tuple(
-                        cur.get("boundaries", ())
-                    ):
-                        # divergent boundaries across workers: bucket-wise
-                        # merge would be meaningless and render a corrupt
-                        # Prometheus histogram (le="+Inf" < _count). Keep
-                        # count/sum, drop bucket detail for the metric.
-                        cur["boundaries"] = ()
-                        for st in cur["series"].values():
-                            st["buckets"] = []
-                    prev = cur["series"].get(k)
-                    if prev is None:
-                        cur["series"][k] = (
-                            v if cur.get("boundaries")
-                            else dict(v, buckets=[])
-                        )
-                    else:
-                        prev["sum"] += v["sum"]
-                        prev["count"] += v["count"]
-                        prev["buckets"] = [
-                            a + b
-                            for a, b in zip(prev["buckets"], v["buckets"])
-                        ]
+            seen_tokens.add(token)
+        else:  # legacy shape: a bare snapshot, no process identity
+            snap = reply
+        _merge_snapshot_into(merged, snap)
     return merged

@@ -152,3 +152,10 @@ config.define("lineage_max_bytes", 256 * 1024 * 1024)
 config.define("actor_max_restarts", 0)
 config.define("log_to_driver", True)
 config.define("temp_dir", "/tmp/ray_tpu")
+# Observability (C18). trace_events gates task lifecycle span stamping
+# (RT_TRACE_EVENTS=0 disables); observability_enabled gates the built-in
+# core metrics (scheduler/lease/object-store/RPC/serve). Both are read
+# once into module-level flags (ray_tpu/observability) so the disabled
+# hot path costs a single attribute check, not a registry lookup.
+config.define("trace_events", True)
+config.define("observability_enabled", True)

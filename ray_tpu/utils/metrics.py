@@ -12,10 +12,21 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+import uuid
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _lock = threading.Lock()
 _registry: Dict[str, "_Metric"] = {}
+# Modules holding module-level instrument references (e.g. the built-in
+# core metrics) register a hook to re-create them after a registry wipe
+# — a wiped registry would otherwise silently detach their instruments.
+_reset_hooks: List[Callable[[], None]] = []
+
+# Per-process identity for deduplicating scrapes: the head runs control
+# store + node agent + driver in ONE process, so state.cluster_metrics
+# must not sum that registry three times when it polls all three
+# addresses.
+PROCESS_TOKEN = uuid.uuid4().hex
 
 _DEFAULT_BOUNDARIES = (
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
@@ -111,6 +122,9 @@ class Histogram(_Metric):
                  boundaries: Sequence[float] = _DEFAULT_BOUNDARIES,
                  tag_keys: Sequence[str] = ()):
         self.boundaries = tuple(sorted(boundaries))
+        # observe() sits on the RPC hot path: bisect over this prebuilt
+        # list instead of rebuilding list(self.boundaries) per call
+        self._bounds_list = list(self.boundaries)
         super().__init__(name, description, tag_keys)
 
     def _validate_rereg(self, existing: "_Metric") -> None:
@@ -134,7 +148,7 @@ class Histogram(_Metric):
                     "count": 0,
                 }
                 self._series[k] = state
-            idx = bisect.bisect_left(list(self.boundaries), value)
+            idx = bisect.bisect_left(self._bounds_list, value)
             state["buckets"][idx] += 1
             state["sum"] += value
             state["count"] += 1
@@ -159,11 +173,26 @@ def snapshot_all() -> Dict[str, Dict]:
     return {m.name: m.snapshot() for m in metrics}
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, and line feed must be escaped or the line is unparseable."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _escape_help(s: str) -> str:
+    """HELP text escaping per the exposition spec: backslash and LF."""
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def prometheus_text(snapshots: Dict[str, Dict]) -> str:
     """Render aggregated snapshots in Prometheus exposition format."""
     lines: List[str] = []
     for name, snap in sorted(snapshots.items()):
-        lines.append(f"# HELP {name} {snap.get('description', '')}")
+        lines.append(
+            f"# HELP {name} {_escape_help(snap.get('description', ''))}"
+        )
         kind = snap["kind"]
         if kind == "histogram" and not snap.get("boundaries"):
             # bucket detail was dropped (divergent boundaries across
@@ -173,7 +202,8 @@ def prometheus_text(snapshots: Dict[str, Dict]) -> str:
         lines.append(f"# TYPE {name} {kind}")
         for tagvals, value in snap["series"].items():
             labels = ",".join(
-                f'{k}="{v}"' for k, v in zip(snap["tag_keys"], tagvals) if v
+                f'{k}="{_escape_label_value(v)}"'
+                for k, v in zip(snap["tag_keys"], tagvals) if v
             )
             label_s = "{" + labels + "}" if labels else ""
             if snap["kind"] == "histogram":
@@ -191,6 +221,14 @@ def prometheus_text(snapshots: Dict[str, Dict]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def register_reset_hook(fn: Callable[[], None]) -> None:
+    """Run fn after every registry reset (idempotent registration)."""
+    if fn not in _reset_hooks:
+        _reset_hooks.append(fn)
+
+
 def _reset_for_tests() -> None:
     with _lock:
         _registry.clear()
+    for fn in _reset_hooks:
+        fn()

@@ -24,12 +24,75 @@ import time
 import traceback
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu.observability import core_metrics
 from ray_tpu.utils import serialization
 from ray_tpu.utils.config import config
 
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
+
+# Method-family buckets for the built-in RPC latency histogram: one
+# series per subsystem, not per method (bounded cardinality).
+_FAMILY_PREFIXES = (
+    ("kv_", "kv"),
+    ("lease_worker", "lease"),
+    ("release_worker", "lease"),
+    ("push_task", "task"),
+    ("actor_task", "task"),
+    ("stream_item", "task"),
+    ("cancel_task", "task"),
+    ("create_actor", "actor"),
+    ("get_actor", "actor"),
+    ("wait_actor", "actor"),
+    ("get_named_actor", "actor"),
+    ("kill_actor", "actor"),
+    ("actor_", "actor"),
+    ("report_actor", "actor"),
+    ("get_object", "object"),
+    ("peek_object", "object"),
+    ("free_object", "object"),
+    ("create_object", "object"),
+    ("seal_object", "object"),
+    ("delete_objects", "object"),
+    ("object_contains", "object"),
+    ("read_object_chunk", "object"),
+    ("wait_objects", "object"),
+    ("add_borrow", "object"),
+    ("release_borrow", "object"),
+    ("store_usage", "object"),
+    ("register_", "node"),
+    ("heartbeat", "node"),
+    ("get_nodes", "node"),
+    ("get_cluster_view", "node"),
+    ("capacity_freed", "node"),
+    ("drain_node", "node"),
+    ("prepare_bundles", "pg"),
+    ("commit_bundles", "pg"),
+    ("return_bundles", "pg"),
+    ("create_placement_group", "pg"),
+    ("get_placement_group", "pg"),
+    ("wait_placement_group", "pg"),
+    ("remove_placement_group", "pg"),
+    ("list_placement_groups", "pg"),
+    ("get_state", "state"),
+    ("get_metrics", "state"),
+    ("get_task_events", "state"),
+    ("list_", "state"),
+)
+_family_cache: Dict[str, str] = {}
+
+
+def _method_family(method: str) -> str:
+    family = _family_cache.get(method)
+    if family is None:
+        family = "other"
+        for prefix, fam in _FAMILY_PREFIXES:
+            if method.startswith(prefix):
+                family = fam
+                break
+        _family_cache[method] = family
+    return family
 
 
 class RpcError(Exception):
@@ -439,6 +502,13 @@ class RpcClient:
                     with self._pending_lock:
                         pending = self._pending.pop(req_id, None)
                     if pending is not None:
+                        if pending.t0 is not None and core_metrics.ENABLED:
+                            core_metrics.rpc_client_latency_s.observe(
+                                time.monotonic() - pending.t0,
+                                tags={
+                                    "family": _method_family(pending.method)
+                                },
+                            )
                         pending.set(ok, payload)
                 elif msg[0] == "push":
                     _, topic, payload = msg
@@ -505,6 +575,9 @@ class RpcClient:
             req_id = self._next_id
             pending = _PendingCall()
             self._pending[req_id] = pending
+        if core_metrics.ENABLED:
+            pending.method = method
+            pending.t0 = time.monotonic()
         payload = serialization.dumps(("req", req_id, method, args, kwargs))
         try:
             _send_frame(sock, payload, self._send_lock)
@@ -541,6 +614,9 @@ class RpcClient:
             req_id = self._next_id
             pending = _PendingCall()
             self._pending[req_id] = pending
+        if core_metrics.ENABLED:
+            pending.method = method
+            pending.t0 = time.monotonic()
         payload = serialization.dumps(("req", req_id, method, args, kwargs))
         try:
             _send_frame(sock, payload, self._send_lock)
@@ -558,7 +634,10 @@ class RpcClient:
 
 
 class _PendingCall:
-    __slots__ = ("event", "ok", "payload", "_cbs", "_cb_lock", "_done")
+    __slots__ = (
+        "event", "ok", "payload", "_cbs", "_cb_lock", "_done",
+        "t0", "method",
+    )
 
     def __init__(self):
         self.event = threading.Event()
@@ -567,6 +646,10 @@ class _PendingCall:
         self._cbs = []
         self._cb_lock = threading.Lock()
         self._done = False
+        # set when core metrics are enabled: the read loop observes the
+        # round-trip into rt_rpc_client_latency_s on reply
+        self.t0 = None
+        self.method = None
 
     def set(self, ok: bool, payload: Any) -> None:
         self.ok = ok

@@ -1,7 +1,12 @@
 """Metrics / state API / timeline / CLI tests (parity model:
-python/ray/tests/test_state_api.py, test_metrics_agent.py subset)."""
+python/ray/tests/test_state_api.py, test_metrics_agent.py subset), plus
+the runtime self-instrumentation layer (observability/): built-in core
+metrics, task lifecycle tracing, flow events, and task_summary."""
 
 import json
+import threading
+import time
+from collections import deque
 
 import pytest
 
@@ -14,6 +19,14 @@ def rt():
     ray_tpu.init(num_cpus=4)
     yield ray_tpu
     ray_tpu.shutdown()
+
+
+def _exec_events(events, name):
+    """Execution slices only (lifecycle instants share the ring)."""
+    return [
+        e for e in events
+        if e["name"] == name and e.get("type") != "lifecycle"
+    ]
 
 
 def test_metrics_api_local():
@@ -41,6 +54,74 @@ def test_metrics_api_local():
     assert "lat_s_count 3" in text
     with pytest.raises(ValueError):
         c.inc(-1)
+
+
+def test_prometheus_label_value_escaping():
+    from ray_tpu.utils import metrics
+
+    metrics._reset_for_tests()
+    c = metrics.Counter("esc_total", "line1\nline2", tag_keys=("v",))
+    c.inc(tags={"v": 'quo"te\\slash\nnewline'})
+    text = metrics.prometheus_text(metrics.snapshot_all())
+    # exposition format: \ -> \\, " -> \", LF -> \n inside label values
+    assert 'esc_total{v="quo\\"te\\\\slash\\nnewline"} 1.0' in text
+    # HELP text: backslash + LF escaping keeps the line single-line
+    assert "# HELP esc_total line1\\nline2" in text
+    assert "\nline2" not in text.replace("\\nline2", "")
+
+
+def _hist_snap(boundaries, buckets, count=None, total=1.0):
+    return {
+        "lat_s": {
+            "kind": "histogram",
+            "description": "",
+            "tag_keys": (),
+            "boundaries": tuple(boundaries),
+            "series": {
+                (): {
+                    "buckets": list(buckets),
+                    "count": count if count is not None else sum(buckets),
+                    "sum": total,
+                }
+            },
+        }
+    }
+
+
+def test_cluster_metrics_histogram_merge_same_boundaries():
+    snap_a = _hist_snap((0.1, 1.0), [1, 2, 3], total=2.5)
+    snap_b = _hist_snap((0.1, 1.0), [4, 0, 1], total=1.5)
+    merged = state.merge_metric_snapshots([snap_a, snap_b])
+    s = merged["lat_s"]["series"][()]
+    assert s["buckets"] == [5, 2, 4]
+    assert s["count"] == 11
+    assert s["sum"] == 4.0
+    assert tuple(merged["lat_s"]["boundaries"]) == (0.1, 1.0)
+    # pure: the inputs survive unchanged (no in-place adoption), so
+    # re-merging the same snapshots cannot double-count
+    assert snap_a["lat_s"]["series"][()]["buckets"] == [1, 2, 3]
+    assert snap_a["lat_s"]["series"][()]["count"] == 6
+    again = state.merge_metric_snapshots([snap_a, snap_b])
+    assert again["lat_s"]["series"][()]["count"] == 11
+
+
+def test_cluster_metrics_histogram_merge_divergent_boundaries():
+    merged = state.merge_metric_snapshots([
+        _hist_snap((0.1, 1.0), [1, 2, 3], total=2.5),
+        _hist_snap((0.5,), [4, 1], total=1.5),
+    ])
+    s = merged["lat_s"]["series"][()]
+    # bucket-wise sum across different boundaries is meaningless: the
+    # merge degrades to count/sum (a summary), dropping bucket detail
+    assert merged["lat_s"]["boundaries"] == ()
+    assert s["buckets"] == []
+    assert s["count"] == 11
+    assert s["sum"] == 4.0
+    from ray_tpu.utils import metrics
+
+    text = metrics.prometheus_text(merged)
+    assert "# TYPE lat_s summary" in text
+    assert "_bucket" not in text
 
 
 def test_state_api_lists(rt):
@@ -72,14 +153,188 @@ def test_task_events_and_timeline(rt, tmp_path):
 
     assert ray_tpu.get([traced_work.remote(i) for i in range(3)]) == [1, 2, 3]
     events = state.task_events()
-    mine = [e for e in events if e["name"] == "traced_work"]
+    mine = _exec_events(events, "traced_work")
     assert len(mine) >= 3
     assert all(e["dur_us"] >= 0 and e["ts_us"] > 0 for e in mine)
+    # owner-side lifecycle instants ride the same collection
+    submitted = [
+        e for e in events
+        if e.get("type") == "lifecycle" and e["phase"] == "submitted"
+        and e["name"] == "traced_work"
+    ]
+    assert len(submitted) >= 3
 
     out = str(tmp_path / "trace.json")
     state.timeline(out_path=out)
     trace = json.load(open(out))
     assert any(ev["name"] == "traced_work" and ev["ph"] == "X" for ev in trace)
+
+
+def test_timeline_flow_events_cross_pid(rt):
+    @ray_tpu.remote
+    def flow_work():
+        return 1
+
+    assert ray_tpu.get([flow_work.remote() for _ in range(5)]) == [1] * 5
+    trace = state.timeline()
+    starts = {
+        e["id"]: e for e in trace
+        if e.get("ph") == "s" and e["name"] == "flow_work"
+    }
+    finishes = [
+        e for e in trace
+        if e.get("ph") == "f" and e["name"] == "flow_work"
+        and e["id"] in starts
+    ]
+    assert len(starts) >= 5 and len(finishes) >= 5
+    for f in finishes:
+        s = starts[f["id"]]
+        # the flow must CROSS processes: submit on the driver pid, bind
+        # to the execution slice on a worker pid
+        assert f["pid"] != s["pid"]
+        assert f.get("bp") == "e"
+        # ...and bind to a real execution slice at the same ts/pid
+        assert any(
+            x.get("ph") == "X" and x["pid"] == f["pid"]
+            and x["ts"] == f["ts"] and x["name"] == "flow_work"
+            for x in trace
+        )
+    # driver also carries a visible submit anchor slice
+    assert any(
+        e.get("ph") == "X" and e["name"] == "submit:flow_work"
+        for e in trace
+    )
+
+
+def test_task_summary_percentiles(rt):
+    @ray_tpu.remote
+    def summarized():
+        time.sleep(0.001)
+        return 1
+
+    assert all(
+        r == 1 for r in ray_tpu.get([summarized.remote() for _ in range(200)])
+    )
+    summary = state.task_summary()
+    entry = summary["tasks"]["summarized"]
+    assert entry["count"] >= 200
+    ex = entry["exec_s"]
+    qw = entry["queue_wait_s"]
+    for pct in ("p50", "p95", "p99"):
+        assert ex[pct] > 0, f"exec {pct} should be nonzero"
+        assert qw[pct] > 0, f"queue-wait {pct} should be nonzero"
+    assert ex["p50"] <= ex["p95"] <= ex["p99"] <= ex["max"]
+    assert qw["p50"] <= qw["p95"] <= qw["p99"] <= qw["max"]
+
+
+def test_task_events_dropped_reported(rt):
+    from ray_tpu.core import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    saved_ring = w._task_events
+    saved_dropped = w._task_events_dropped
+    try:
+        w._task_events = deque(maxlen=4)
+        w._task_events_dropped = 0
+        for i in range(10):
+            w._append_task_event({"type": "lifecycle", "phase": "submitted",
+                                  "task_id": f"t{i}", "name": "x",
+                                  "ts_us": 1, "worker": w.address, "pid": 0})
+        assert w._task_events_dropped == 6
+        reply = w.rpc_get_task_events(None)
+        assert reply["dropped"] == 6 and len(reply["events"]) == 4
+        summary = state.task_summary()
+        assert summary["events_dropped"] >= 6
+        # clear=True starts a fresh window: the drop count restarts too
+        reply = w.rpc_get_task_events(None, clear=True)
+        assert reply["dropped"] == 6
+        reply = w.rpc_get_task_events(None)
+        assert reply["dropped"] == 0 and reply["events"] == []
+    finally:
+        w._task_events = saved_ring
+        w._task_events_dropped = saved_dropped
+
+
+def test_trace_kill_switch(rt):
+    from ray_tpu.core import worker as worker_mod
+    from ray_tpu.observability import tracing
+
+    @ray_tpu.remote
+    def untraced_work():
+        return 1
+
+    w = worker_mod.global_worker()
+    tracing.set_enabled(False)
+    try:
+        assert ray_tpu.get(untraced_work.remote()) == 1
+        # the owner stamped NO lifecycle events while disabled
+        assert not any(
+            e.get("type") == "lifecycle" and e["name"] == "untraced_work"
+            for e in list(w._task_events)
+        )
+    finally:
+        tracing.set_enabled(True)
+    assert ray_tpu.get(untraced_work.remote()) == 1
+    assert any(
+        e.get("type") == "lifecycle" and e["name"] == "untraced_work"
+        for e in list(w._task_events)
+    )
+
+
+def test_builtin_core_metrics(rt):
+    from ray_tpu.serve.batching import batch
+    from ray_tpu.utils import metrics as metrics_mod
+
+    @ray_tpu.remote
+    def metered():
+        return 1
+
+    assert ray_tpu.get([metered.remote() for _ in range(10)]) == [1] * 10
+    # a >direct-call-threshold object lands in the agent's shm store and
+    # sets the store gauges
+    big_ref = ray_tpu.put(b"x" * 200_000)
+    assert len(ray_tpu.get(big_ref)) == 200_000
+
+    # exercise a serve-family series without booting the serve runtime
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+    def batched(xs):
+        return [x + 1 for x in xs]
+
+    threads = [
+        threading.Thread(target=lambda: batched(1)) for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    agg = state.cluster_metrics()
+    populated = {
+        name for name, m in agg.items()
+        if name.startswith("rt_") and m["series"]
+    }
+    expected = {
+        "rt_sched_queue_depth",            # scheduler
+        "rt_sched_dispatch_latency_s",     # scheduler
+        "rt_lease_requests_total",         # lease (agent)
+        "rt_lease_grants_total",           # lease (agent)
+        "rt_lease_cache_hits_total",       # lease (owner)
+        "rt_worker_pool_size",             # worker pool
+        "rt_object_store_used_bytes",      # object store
+        "rt_rpc_client_latency_s",         # rpc
+        "rt_serve_batch_size",             # serve
+    }
+    missing = expected - populated
+    assert not missing, f"missing built-in series: {missing}"
+    assert len(populated) >= 8
+    # and they render as scrapeable exposition text
+    text = metrics_mod.prometheus_text(agg)
+    assert "rt_rpc_client_latency_s_bucket" in text
+    assert "rt_lease_grants_total" in text
+    # the lease cache pipelines tasks: grants never exceed cache hits here
+    grants = sum(agg["rt_lease_grants_total"]["series"].values())
+    hits = sum(agg["rt_lease_cache_hits_total"]["series"].values())
+    assert grants >= 1 and hits >= 10
 
 
 def test_worker_metrics_aggregate(rt):
@@ -115,6 +370,13 @@ def test_cli_smoke(rt, tmp_path, capsys):
     capsys.readouterr()
     json.load(open(tl))
     assert main(["--address", addr, "metrics"]) == 0
+    capsys.readouterr()
+    assert main(["--address", addr, "summary"]) == 0
+    out = capsys.readouterr().out
+    assert "QUEUE_P50_MS" in out and "EXEC_P99_MS" in out
+    assert main(["--address", addr, "--json", "summary"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert "tasks" in parsed and "events_dropped" in parsed
 
 
 def test_dashboard_endpoints(rt):
@@ -146,10 +408,21 @@ def test_dashboard_endpoints(rt):
         status, body = fetch("/api/timeline")
         assert status == 200
         assert any(e["name"] == "tiny" for e in json.loads(body))
+        status, body = fetch("/api/task_summary")
+        assert status == 200
+        summary = json.loads(body)
+        assert "tiny" in summary["tasks"]
         status, body = fetch("/")
         assert status == 200 and b"ray_tpu cluster" in body
         status, body = fetch("/metrics")
         assert status == 200
+        text = body.decode()
+        rt_series = {
+            line.split("{")[0].split(" ")[0]
+            for line in text.splitlines()
+            if line.startswith("rt_") and not line.startswith("#")
+        }
+        assert len(rt_series) >= 8, f"built-in series seen: {rt_series}"
         try:
             fetch("/nope")
             raise AssertionError("expected 404")
