@@ -48,6 +48,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     tl = sub.add_parser("timeline", help="dump a Chrome-trace timeline")
     tl.add_argument("--out", default="timeline.json")
+    head = sub.add_parser("head", help="run / manage a standalone head")
+    headsub = head.add_subparsers(dest="head_cmd", required=True)
+    hs = headsub.add_parser("start", help="run the head in the foreground")
+    hs.add_argument("--host", default="127.0.0.1")
+    hs.add_argument("--port", type=int, default=0,
+                    help="fix the port to make the head restartable in place")
+    hs.add_argument("--session-id", default=None)
+    hs.add_argument("--persist", default=None,
+                    help="durable-log base path (snapshot + .wal)")
+    hs.add_argument("--address-file", default=None,
+                    help="publish the head address here for re-attach")
+    sub.add_parser(
+        "head-restart",
+        help="bounce a standalone head in place (persist, re-exec, "
+             "reconcile) — requires rt head start --persist + --port",
+    )
+    mem = sub.add_parser(
+        "memory", help="per-node object-store contents + owner borrow "
+                       "state (leaked-borrow triage)",
+    )
+    mem.add_argument("--min-bytes", type=int, default=0,
+                     help="hide objects smaller than this")
+    logs = sub.add_parser(
+        "logs", help="tail worker stdout/stderr across the cluster",
+    )
+    logs.add_argument("job_id", nargs="?", default=None,
+                      help="job to attribute (informational; all worker "
+                           "logs of the session are shown)")
+    logs.add_argument("--tail-bytes", type=int, default=4096)
     sub.add_parser(
         "summary",
         help="per-task queue-wait / exec latency percentiles",
@@ -74,6 +103,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ray_tpu import state
 
     addr = args.address
+    if args.cmd == "head":
+        if args.head_cmd == "start":
+            from ray_tpu.core import head_main
+
+            argv = ["--host", args.host, "--port", str(args.port)]
+            if args.session_id:
+                argv += ["--session-id", args.session_id]
+            if args.persist:
+                argv += ["--persist", args.persist]
+            if args.address_file:
+                argv += ["--address-file", args.address_file]
+            head_main.main(argv)
+            return 0
+        return 1
+    if args.cmd == "head-restart":
+        from ray_tpu.utils.rpc import RemoteError, RpcClient
+
+        if not addr:
+            print("--address (or $RT_ADDRESS) required", file=sys.stderr)
+            return 2
+        client = RpcClient(addr, name="head-restart")
+        try:
+            client.call("head_restart", timeout_s=15.0)
+            print(f"head at {addr} restarting (reconciliation follows)")
+            return 0
+        except RemoteError as e:
+            if "no handler" in str(e):
+                print(
+                    "head-restart needs a standalone head "
+                    "(`rt head start --persist ... --port ...`); this head "
+                    "runs inside a driver process", file=sys.stderr,
+                )
+            else:
+                print(f"head refused restart: {e}", file=sys.stderr)
+            return 1
+        finally:
+            client.close()
     if args.cmd == "status":
         st = state.cluster_status(addr)
         if args.as_json:
@@ -83,6 +149,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             avail = st["resources_available"]
             print(f"nodes: {st['nodes_alive']} alive, {st['nodes_dead']} dead")
             print(f"workers: {st['workers']}")
+            ha = st.get("head_ha") or {}
+            if ha.get("enabled"):
+                line = (
+                    f"head HA: durable log on (epoch {ha.get('epoch', 0)}, "
+                    f"{ha.get('wal_since_snapshot', 0)} WAL entries since "
+                    f"snapshot)"
+                )
+                if ha.get("recovering"):
+                    line += (
+                        f"; RECONCILING ({len(ha.get('unreconciled_nodes', []))} "
+                        f"nodes pending, {ha.get('reconcile_remaining_s', 0):.1f}s "
+                        f"left in window)"
+                    )
+                print(line)
+            else:
+                print("head HA: off (in-memory control store)")
             print(
                 "actors: "
                 + ", ".join(f"{k}={v}" for k, v in st["actors"].items())
@@ -122,6 +204,61 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "timeline":
         path = state.timeline(addr, out_path=args.out)
         print(f"wrote {path} (open in chrome://tracing or ui.perfetto.dev)")
+        return 0
+    if args.cmd == "memory":
+        objs = [
+            o for o in state.objects(addr)
+            if (o.get("size") or 0) >= args.min_bytes or o.get("borrows")
+            or o.get("inflight_pins")
+        ]
+        if args.as_json:
+            print(json.dumps(objs, indent=2))
+            return 0
+        rows = []
+        for o in objs:
+            rows.append({
+                "object_id": o["object_id"][:16],
+                "node": (o.get("node_id") or "-")[:8],
+                "location": o.get("location", "-"),
+                "size": o.get("size") if o.get("size") is not None else "-",
+                "state": o.get("state", "-"),
+                "borrows": o.get("borrows", 0),
+                "pins": o.get("inflight_pins", 0),
+                "oldest_pin_s": (
+                    f"{o['oldest_pin_age_s']:.1f}"
+                    if o.get("oldest_pin_age_s") else "-"
+                ),
+            })
+        print(_fmt_table(rows, [
+            "object_id", "node", "location", "size", "state",
+            "borrows", "pins", "oldest_pin_s",
+        ]))
+        leaked = {
+            o["object_id"] for o in objs
+            if o.get("oldest_pin_age_s", 0) > 60.0 and o.get("inflight_pins")
+        }
+        if leaked:
+            print(
+                f"warning: {len(leaked)} object(s) held by in-flight pins "
+                f"older than 60s — likely leaked borrows"
+            )
+        return 0
+    if args.cmd == "logs":
+        logs = state.worker_logs(addr, tail_bytes=args.tail_bytes)
+        if args.as_json:
+            print(json.dumps(logs, indent=2))
+            return 0
+        if args.job_id:
+            print(f"# worker logs (cluster-wide; job {args.job_id})")
+        for entry in logs:
+            if not entry["tail"]:
+                continue
+            who = entry.get("worker_id", entry["file"])
+            print(
+                f"==> node {entry['node_id'][:8]} {who} "
+                f"[{entry['stream']}] <=="
+            )
+            print(entry["tail"], end="" if entry["tail"].endswith("\n") else "\n")
         return 0
     if args.cmd == "summary":
         summary = state.task_summary(addr)

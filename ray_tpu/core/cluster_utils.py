@@ -21,38 +21,32 @@ from ray_tpu.utils.config import config
 from ray_tpu.utils.rpc import RpcClient
 
 
-def spawn_node_agent(
-    control_address: str,
+def _spawn_with_handshake(
+    cmd: List[str],
     session_id: str,
-    resources: Dict[str, float],
-    labels: Optional[Dict[str, str]] = None,
+    log_prefix: str,
     startup_timeout_s: float = 60.0,
 ):
-    """Start a node agent process and wait for its one-line JSON startup
-    handshake. Shared by the test Cluster and the autoscaler's
-    LocalNodeProvider — the spawn protocol must not fork."""
+    """Spawn a cluster daemon and wait for its one-line JSON startup
+    handshake — THE spawn protocol, shared by node agents and standalone
+    heads (it must not fork per call site)."""
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     env = dict(os.environ)
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     env["RT_CONFIG_SNAPSHOT"] = config.snapshot()
-    # stderr goes to a FILE, not a pipe: nothing drains node logs for the
-    # process's lifetime, and a filled 64KB pipe would block the agent
+    # stderr goes to a FILE, not a pipe: nothing drains daemon logs for
+    # the process's lifetime, and a filled 64KB pipe would block it
     log_dir = os.path.join(config.temp_dir, f"session_{session_id[:8]}", "logs")
     os.makedirs(log_dir, exist_ok=True)
-    stderr_path = os.path.join(log_dir, f"node-{uuid.uuid4().hex[:8]}.err")
+    stderr_path = os.path.join(
+        log_dir, f"{log_prefix}-{uuid.uuid4().hex[:8]}.err"
+    )
     stderr_f = open(stderr_path, "wb")
     try:
         proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "ray_tpu.core.node_main",
-                "--control-address", control_address,
-                "--session-id", session_id,
-                "--resources", json.dumps(resources),
-                "--labels", json.dumps(labels or {}),
-            ],
-            env=env, stdout=subprocess.PIPE, stderr=stderr_f,
+            cmd, env=env, stdout=subprocess.PIPE, stderr=stderr_f,
             start_new_session=True,
         )
     finally:
@@ -79,9 +73,53 @@ def spawn_node_agent(
         except OSError:
             tail = ""
         raise RuntimeError(
-            f"node agent spawn failed (rc={proc.returncode}): {tail}"
+            f"{log_prefix} spawn failed (rc={proc.returncode}): {tail}"
         )
     return proc, json.loads(line)
+
+
+def spawn_node_agent(
+    control_address: str,
+    session_id: str,
+    resources: Dict[str, float],
+    labels: Optional[Dict[str, str]] = None,
+    startup_timeout_s: float = 60.0,
+):
+    """Start a node agent process (shared by the test Cluster and the
+    autoscaler's LocalNodeProvider)."""
+    return _spawn_with_handshake(
+        [
+            sys.executable, "-m", "ray_tpu.core.node_main",
+            "--control-address", control_address,
+            "--session-id", session_id,
+            "--resources", json.dumps(resources),
+            "--labels", json.dumps(labels or {}),
+        ],
+        session_id, "node", startup_timeout_s,
+    )
+
+
+def spawn_head(
+    session_id: str,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    persistence_path: Optional[str] = None,
+    address_file: Optional[str] = None,
+    startup_timeout_s: float = 60.0,
+):
+    """Start a standalone head process (core/head_main.py) — the harness
+    for head fault-tolerance tests (kill -9 the head, spawn it again on
+    the same port + durable log) and for `rt head start`."""
+    cmd = [
+        sys.executable, "-m", "ray_tpu.core.head_main",
+        "--host", host, "--port", str(port),
+        "--session-id", session_id,
+    ]
+    if persistence_path:
+        cmd += ["--persist", persistence_path]
+    if address_file:
+        cmd += ["--address-file", address_file]
+    return _spawn_with_handshake(cmd, session_id, "head", startup_timeout_s)
 
 
 class ClusterNode:
@@ -92,19 +130,80 @@ class ClusterNode:
 
 
 class Cluster:
-    def __init__(self):
-        self.session_id = uuid.uuid4().hex
-        self.control = ControlStore(self.session_id)
-        self.control.start()
-        from ray_tpu.utils.gateway import Gateway
+    """external_head=True runs the control store as its own process (via
+    spawn_head) so tests can kill -9 and restart it; the default keeps
+    the store in-process (fast, no failover surface)."""
 
-        self.gateway = Gateway(self.control.address)
-        self.gateway.start()
+    def __init__(self, external_head: bool = False,
+                 persistence_path: Optional[str] = None,
+                 address_file: Optional[str] = None):
+        self.session_id = uuid.uuid4().hex
+        self.persistence_path = persistence_path
+        self.address_file = address_file
+        self.control: Optional[ControlStore] = None
+        self.head_proc: Optional[subprocess.Popen] = None
+        self.gateway = None
+        if external_head:
+            self.head_proc, info = spawn_head(
+                self.session_id,
+                persistence_path=persistence_path,
+                address_file=address_file,
+            )
+            self._address = info["address"]
+            self._head_host, head_port = self._address.rsplit(":", 1)
+            self._head_port = int(head_port)
+        else:
+            self.control = ControlStore(
+                self.session_id, persistence_path=persistence_path
+            )
+            self.control.start()
+            from ray_tpu.utils.gateway import Gateway
+
+            self.gateway = Gateway(self.control.address)
+            self.gateway.start()
+            self._address = self.control.address
         self.nodes: List[ClusterNode] = []
 
     @property
     def address(self) -> str:
-        return self.control.address
+        return self._address
+
+    # -- head fault-tolerance harness (external_head only) --
+
+    def kill_head(self) -> None:
+        """SIGKILL the head process — the failure HA must survive."""
+        assert self.head_proc is not None, "kill_head needs external_head"
+        try:
+            os.killpg(os.getpgid(self.head_proc.pid), 9)
+        except (ProcessLookupError, PermissionError):
+            self.head_proc.kill()
+        self.head_proc.wait()
+
+    def restart_head(self, wait_ready_s: float = 60.0) -> None:
+        """Respawn the head on the SAME address + durable log and wait
+        until its control store answers."""
+        self.head_proc, info = spawn_head(
+            self.session_id,
+            host=self._head_host,
+            port=self._head_port,
+            persistence_path=self.persistence_path,
+            address_file=self.address_file,
+        )
+        assert info["address"] == self._address, (
+            f"head restarted at {info['address']}, expected {self._address}"
+        )
+        client = RpcClient(self._address, name="head-wait")
+        deadline = time.monotonic() + wait_ready_s
+        try:
+            while time.monotonic() < deadline:
+                try:
+                    client.call("ha_status", timeout_s=5.0)
+                    return
+                except Exception:  # noqa: BLE001 — still booting
+                    time.sleep(0.1)
+            raise TimeoutError("restarted head did not become ready")
+        finally:
+            client.close()
 
     def add_node(
         self,
@@ -185,10 +284,11 @@ class Cluster:
             client.close()
 
     def shutdown(self) -> None:
-        try:
-            self.gateway.stop()
-        except Exception:  # noqa: BLE001
-            pass
+        if self.gateway is not None:
+            try:
+                self.gateway.stop()
+            except Exception:  # noqa: BLE001
+                pass
         for node in list(self.nodes):
             try:
                 os.killpg(os.getpgid(node.proc.pid), 15)
@@ -200,4 +300,14 @@ class Cluster:
             except subprocess.TimeoutExpired:
                 node.proc.kill()
         self.nodes.clear()
-        self.control.stop()
+        if self.control is not None:
+            self.control.stop()
+        if self.head_proc is not None and self.head_proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.head_proc.pid), 15)
+            except (ProcessLookupError, PermissionError):
+                self.head_proc.terminate()
+            try:
+                self.head_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.head_proc.kill()

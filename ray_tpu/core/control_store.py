@@ -11,8 +11,16 @@ gcs_placement_group_scheduler.h:115-117), jobs (GcsJobManager), KV store
 the resource-view syncer (src/ray/ray_syncer/ray_syncer.h:91 — here:
 heartbeat-carried resource reports fanned out on a pubsub topic).
 
-Runs as threads inside the head process; all state in-memory (a persistence
-hook mirrors the Redis-backed FT mode and can be added behind StoreBackend).
+Runs as threads inside the head process. State is in-memory, with an
+optional durable log behind it (core/ha/wal.py — the reference's
+Redis-backed GCS FT mode, C14): every durable table mutation flows
+through ONE choke point, ``_apply``, which dispatches to a ``_mut_*``
+state-machine function and appends the fully-resolved operation to a
+write-ahead log. Recovery replays snapshot+WAL through the same
+functions, rebuilding byte-identical tables, then runs a bounded
+*reconciliation window* in which live node agents re-attach and
+re-assert their leases/bundles/workers before scheduling resumes
+(tools/check_wal_choke.py statically enforces the choke point).
 """
 
 from __future__ import annotations
@@ -20,13 +28,13 @@ from __future__ import annotations
 import heapq
 import itertools
 import logging
-import os
 import queue
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import scheduling
+from ray_tpu.core.ha import FileBackend, HAState, write_head_address
 from ray_tpu.observability import core_metrics
 from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
@@ -50,18 +58,48 @@ class PGState:
     RESCHEDULING = "RESCHEDULING"
 
 
+# Node-record fields the durable projection keeps — exactly the
+# registration payload plus liveness. Everything else (heartbeat runtime
+# state, reattach bookkeeping, arbitrary `extra` keys) is structurally
+# excluded, so a new runtime field can never silently break replay
+# determinism; agents re-assert runtime state during reconciliation.
+_DURABLE_NODE_FIELDS = (
+    "node_id", "address", "resources_total", "labels",
+    "object_store_capacity", "alive",
+)
+
+# Ops applied through the choke point but NOT appended to the WAL:
+# per-heartbeat runtime state whose replay would be meaningless across a
+# process restart.
+_VOLATILE_OPS = frozenset({"node_runtime"})
+
+
 class ControlStore:
     def __init__(self, session_id: str, host: str = "127.0.0.1", port: int = 0,
                  persistence_path: Optional[str] = None):
         self.session_id = session_id
-        # Pluggable metadata persistence (reference C14: in-memory default
-        # vs Redis FT mode): with a path, the KV and job tables snapshot
-        # to disk and a restarted control store restores them (cluster
-        # membership and worker state re-register via heartbeats).
+        # Durable log (reference C14: in-memory default vs Redis FT mode):
+        # with a path, every durable mutation is WAL'd (snapshot at <path>,
+        # log at <path>.wal) and a restarted control store rebuilds an
+        # identical control plane, then reconciles with live agents.
         self._persistence_path = persistence_path or (
             str(config.control_store_persistence_path) or None
         )
-        self._dirty = False
+        self._ha: Optional[HAState] = None
+        if self._persistence_path:
+            self._ha = HAState(
+                FileBackend(self._persistence_path),
+                compact_entries=int(config.ha_wal_compact_entries),
+                fsync=bool(config.ha_wal_fsync),
+            )
+        # Reconciliation window state (live failover): set by _restore when
+        # previously-alive nodes were recovered from the log.
+        self._recovering = False
+        self._reconcile_deadline = 0.0
+        # node_id -> re-attach report ({"leases": set, "bundles": {pg: set}})
+        # — recorded only during the window, consumed+cleared at finalize
+        self._reattached: Dict[str, Dict[str, Any]] = {}
+        self._reattached_total = 0  # distinct nodes re-attached (status)
         self._server = RpcServer("control_store", host, port)
         self._server.register_instance(self)
         self._server.on_disconnect = self._handle_disconnect
@@ -116,6 +154,7 @@ class ControlStore:
     def start(self) -> None:
         self._restore()
         self._server.start()
+        write_head_address(self.address)
         self._health_thread = threading.Thread(
             target=self._health_loop, name="cs-health", daemon=True
         )
@@ -123,76 +162,449 @@ class ControlStore:
         threading.Thread(
             target=self._sched_loop, name="cs-scheduler", daemon=True
         ).start()
-        if self._persistence_path:
+        if self._recovering:
             threading.Thread(
-                target=self._persist_loop, name="cs-persist", daemon=True
+                target=self._reconcile_loop, name="cs-reconcile", daemon=True
             ).start()
 
     def stop(self) -> None:
         self._stopped.set()
         self._pg_pool.shutdown(wait=False)
-        self._persist(force=True)
+        # server down first, and the final snapshot under the store lock:
+        # an in-flight handler must not append between the close's state
+        # copy and its WAL truncation (the acked op would vanish). An
+        # append that lands after the close is still safe — it reopens
+        # the truncated WAL with seq > snapshot seq and replays.
         self._server.stop()
+        if self._ha is not None:
+            with self._lock:
+                self._ha.close(self._durable_state_snapshot)
         self._agents.close_all()
         self._workers.close_all()
 
-    # -- persistence (reference C14: gcs_table_storage + store_client) --
+    # ------------------------------------------------------------------
+    # durable log (reference C14: gcs_table_storage + store_client) —
+    # THE WAL CHOKE POINT. Every mutation of the state tables (_kv,
+    # _nodes, _actors, _named_actors, _pgs, _jobs, _next_job) must go
+    # through _apply, which runs a _mut_* state-machine function and
+    # appends the fully-resolved op to the WAL. tools/check_wal_choke.py
+    # enforces this statically (tier-1).
+    # ------------------------------------------------------------------
+
+    def _apply(self, op: str, *args):
+        """Sole entry point for state-table mutations. Caller must hold
+        self._lock — appends are thereby totally ordered, and an inline
+        compaction snapshot is consistent with the log position.
+
+        Write-ahead ordering: the op is logged BEFORE the in-memory
+        mutation runs, so an append failure (disk full, closed backend)
+        surfaces to the caller with memory and log still in agreement —
+        logged-but-unapplied is the one crash window, and replay then
+        applies it, which is the WAL contract (logged == committed)."""
+        assert self._lock._is_owned(), "mutation outside the store lock"
+        if (
+            self._ha is not None
+            and op not in _VOLATILE_OPS
+            # collective rendezvous namespaces (coll/*) are incarnation-
+            # scoped: replaying them into a restarted cluster would satisfy
+            # a new group's barrier/op tags with a dead run's keys.
+            and not (op.startswith("kv_") and args[0].startswith("coll/"))
+        ):
+            self._ha.append(op, args, self._durable_state_snapshot)
+        return getattr(self, "_mut_" + op)(*args)
+
+    # -- state-machine mutation functions: pure in-memory table updates,
+    # -- deterministic given their (logged) args; no RPC, no clock reads.
+
+    def _mut_kv_put(self, ns: str, key: str, value: bytes) -> None:
+        self._kv.setdefault(ns, {})[key] = value
+
+    def _mut_kv_del(self, ns: str, key: str) -> bool:
+        return self._kv.get(ns, {}).pop(key, None) is not None
+
+    def _mut_kv_del_prefix(self, ns: str, prefix: str) -> int:
+        table = self._kv.get(ns)
+        if table is None:
+            return 0
+        doomed = [k for k in table if k.startswith(prefix)]
+        for k in doomed:
+            del table[k]
+        if not table and prefix == "":
+            self._kv.pop(ns, None)
+        return len(doomed)
+
+    def _mut_node_register(self, node_id: str, info: Dict[str, Any]) -> None:
+        node = self._nodes.get(node_id)
+        if node is None:
+            node = {}
+            self._nodes[node_id] = node
+        node.update(info)
+        node["alive"] = True
+
+    def _mut_node_runtime(self, node_id: str, fields: Dict[str, Any]) -> None:
+        # VOLATILE: heartbeat-carried runtime state, never WAL'd.
+        node = self._nodes.get(node_id)
+        if node is not None:
+            node.update(fields)
+
+    def _mut_node_dead(self, node_id: str) -> None:
+        node = self._nodes.get(node_id)
+        if node is not None:
+            node["alive"] = False
+
+    def _mut_job_add(self, driver_address: str, metadata: Dict[str, Any],
+                     ts: float) -> str:
+        job_id = JobID.from_int(self._next_job)
+        self._next_job += 1
+        self._jobs[job_id.hex()] = {
+            "job_id": job_id.hex(),
+            "driver_address": driver_address,
+            "metadata": metadata,
+            "start_time": ts,
+            "alive": True,
+        }
+        return job_id.hex()
+
+    def _mut_job_finish(self, job_id: str, ts: float) -> None:
+        job = self._jobs.get(job_id)
+        if job:
+            job["alive"] = False
+            job["end_time"] = ts
+
+    def _mut_actor_register(self, record: Dict[str, Any]) -> None:
+        actor_id = record["actor_id"]
+        self._actors[actor_id] = dict(record)
+        name = record.get("name")
+        if name:
+            self._named_actors[(record.get("namespace", "default"), name)] = (
+                actor_id
+            )
+
+    def _mut_actor_update(self, actor_id: str, fields: Dict[str, Any]) -> None:
+        record = self._actors.get(actor_id)
+        if record is not None:
+            record.update(fields)
+
+    def _mut_pg_add(self, record: Dict[str, Any]) -> None:
+        rec = dict(record)
+        rec["bundle_locations"] = dict(rec.get("bundle_locations") or {})
+        self._pgs[rec["pg_id"]] = rec
+
+    def _mut_pg_update(self, pg_id: str, fields: Dict[str, Any]) -> None:
+        pg = self._pgs.get(pg_id)
+        if pg is not None:
+            pg.update(fields)
+
+    def _mut_pg_merge_locations(self, pg_id: str,
+                                placement: Dict[int, str]) -> None:
+        pg = self._pgs.get(pg_id)
+        if pg is not None:
+            pg["bundle_locations"].update(
+                {int(i): nid for i, nid in placement.items()}
+            )
+
+    def _mut_pg_drop_locations(self, pg_id: str, idxs: List[int]) -> None:
+        pg = self._pgs.get(pg_id)
+        if pg is not None:
+            for i in idxs:
+                pg["bundle_locations"].pop(int(i), None)
+
+    # -- durable projection + snapshot/restore --
+
+    def _durable_state(self) -> Dict[str, Any]:
+        """The WAL-covered tables, minus volatile runtime fields. Replay
+        of snapshot+WAL reproduces this projection byte-identically
+        (tests/test_ha_failover.py::test_wal_replay_determinism)."""
+        return {
+            "kv": {
+                ns: dict(t) for ns, t in self._kv.items()
+                if not ns.startswith("coll/")
+            },
+            "nodes": {
+                nid: {k: n[k] for k in _DURABLE_NODE_FIELDS if k in n}
+                for nid, n in self._nodes.items()
+            },
+            "jobs": {j: dict(r) for j, r in self._jobs.items()},
+            "next_job": self._next_job,
+            "actors": {a: dict(r) for a, r in self._actors.items()},
+            "named_actors": dict(self._named_actors),
+            "pgs": {
+                p: dict(r, bundle_locations=dict(r["bundle_locations"]))
+                for p, r in self._pgs.items()
+            },
+        }
+
+    def _durable_state_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._durable_state()
+
+    def _load_tables(self, tables: Dict[str, Any]) -> None:
+        self._kv = {ns: dict(t) for ns, t in tables.get("kv", {}).items()}
+        self._nodes = {n: dict(r) for n, r in tables.get("nodes", {}).items()}
+        self._jobs = {j: dict(r) for j, r in tables.get("jobs", {}).items()}
+        self._next_job = tables.get("next_job", 1)
+        self._actors = {
+            a: dict(r) for a, r in tables.get("actors", {}).items()
+        }
+        self._named_actors = dict(tables.get("named_actors", {}))
+        self._pgs = {
+            p: dict(r, bundle_locations=dict(r["bundle_locations"]))
+            for p, r in tables.get("pgs", {}).items()
+        }
 
     def _restore(self) -> None:
-        if not self._persistence_path or not os.path.exists(
-            self._persistence_path
-        ):
+        if self._ha is None:
             return
-        import pickle
-
-        try:
-            with open(self._persistence_path, "rb") as f:
-                snap = pickle.load(f)
-            with self._lock:
-                self._kv = snap.get("kv", {})
-                self._jobs = snap.get("jobs", {})
-                self._next_job = snap.get("next_job", 1)
-            logger.info(
-                "control store restored %d KV namespaces, %d jobs from %s",
-                len(self._kv), len(self._jobs), self._persistence_path,
+        tables, records = self._ha.recover()
+        if tables is None and not records:
+            self._ha.start(
+                self._durable_state_snapshot,
+                meta={"session_id": self.session_id},
             )
-        except Exception:  # noqa: BLE001 — corrupt snapshot: start fresh
-            logger.exception("control store snapshot restore failed")
-
-    def _persist(self, force: bool = False) -> None:
-        if not self._persistence_path or not (self._dirty or force):
             return
-        import pickle
-
+        prev_session = self._ha.meta.get("session_id")
         with self._lock:
-            snap = {
-                # Collective rendezvous namespaces (coll/*) are
-                # incarnation-scoped: restoring them would satisfy a new
-                # group's barrier/op tags with a dead run's keys and
-                # return stale tensors as wrong results.
-                "kv": {
-                    ns: dict(t) for ns, t in self._kv.items()
-                    if not ns.startswith("coll/")
-                },
-                "jobs": {j: dict(r) for j, r in self._jobs.items()},
-                "next_job": self._next_job,
-            }
-            self._dirty = False
-        tmp = self._persistence_path + ".tmp"
-        try:
-            os.makedirs(
-                os.path.dirname(os.path.abspath(self._persistence_path)),
-                exist_ok=True,
-            )
-            with open(tmp, "wb") as f:
-                pickle.dump(snap, f)
-            os.replace(tmp, self._persistence_path)
-        except OSError:
-            logger.exception("control store snapshot write failed")
+            if tables is not None:
+                self._load_tables(tables)
+            for op, args in records:
+                try:
+                    getattr(self, "_mut_" + op)(*args)
+                except Exception:  # noqa: BLE001 — replay must not abort
+                    logger.exception("WAL replay of %s%r failed", op, args)
+            self._post_restore_locked()
+        if prev_session:
+            # keep the cluster's session identity stable across the bounce
+            # (agents/workers key temp dirs and shm prefixes by it)
+            self.session_id = prev_session
+        self._ha.start(
+            self._durable_state_snapshot,
+            meta={"session_id": self.session_id},
+        )
+        logger.info(
+            "control store restored (epoch %d): %d nodes, %d actors, "
+            "%d PGs, %d jobs, %d KV namespaces; %s",
+            self._ha.epoch, len(self._nodes), len(self._actors),
+            len(self._pgs), len(self._jobs), len(self._kv),
+            "reconciliation window open" if self._recovering
+            else "no live nodes to reconcile",
+        )
 
-    def _persist_loop(self) -> None:
-        while not self._stopped.wait(1.0):
-            self._persist()
+    def _post_restore_locked(self) -> None:
+        """Reset volatile runtime state after a replay: node liveness is
+        re-asserted by the agents themselves during the reconciliation
+        window; monotonic stamps from the dead process are meaningless."""
+        now = time.monotonic()
+        restored_alive = []
+        for nid in self._nodes:
+            self._apply("node_runtime", nid, {
+                "last_heartbeat": now,
+                "resources_available": dict(
+                    self._nodes[nid].get("resources_total", {})
+                ),
+                "reconciled": False,
+            })
+            if self._nodes[nid].get("alive"):
+                restored_alive.append(nid)
+        self._view_version += 1
+        if restored_alive:
+            self._recovering = True
+            self._reconcile_deadline = now + float(
+                config.ha_reconcile_window_s
+            )
+        # nothing in-flight survives a restart: requeue pending work (the
+        # scheduler defers it until the reconciliation window closes)
+        for aid, r in self._actors.items():
+            if r["state"] in (
+                ActorState.PENDING_CREATION, ActorState.RESTARTING,
+            ):
+                self._sched_enqueue(("actor", aid))
+        for pid, pg in self._pgs.items():
+            if pg["state"] in (PGState.PENDING, PGState.RESCHEDULING):
+                self._sched_enqueue(("pg", pid))
+
+    # -- reconciliation window (live failover) --
+
+    def _reconcile_loop(self) -> None:
+        while not self._stopped.wait(0.1):
+            with self._lock:
+                if not self._recovering:
+                    return
+                pending = [
+                    nid for nid, n in self._nodes.items()
+                    if n.get("alive") and not n.get("reconciled")
+                ]
+                if pending and time.monotonic() < self._reconcile_deadline:
+                    continue
+            self._finalize_reconciliation()
+            return
+
+    def _finalize_reconciliation(self) -> None:
+        with self._lock:
+            # compute the stale set in the same critical section that ends
+            # the window: a node whose reattach lands after this point is
+            # spared again inside _mark_node_dead's reconciled re-check —
+            # a live, successfully re-attached node must never be GC'd
+            self._recovering = False
+            stale_nodes = [
+                nid for nid, n in self._nodes.items()
+                if n.get("alive") and not n.get("reconciled")
+            ]
+        for nid in stale_nodes:
+            logger.warning(
+                "node %s did not re-attach within the reconciliation "
+                "window; garbage-collecting", nid[:8],
+            )
+            self._mark_node_dead(
+                nid, "did not re-attach after head restart",
+                only_if_unreconciled=True,
+            )
+        # Verify restored-ALIVE actors against the agents' re-attach
+        # reports: a worker that died during the outage never told us.
+        lost = []
+        with self._lock:
+            for aid, r in self._actors.items():
+                if r["state"] != ActorState.ALIVE:
+                    continue
+                nid = r.get("node_id")
+                node = self._nodes.get(nid) if nid else None
+                if node is None or not node["alive"]:
+                    continue  # _mark_node_dead above already failed it over
+                report = self._reattached.get(nid)
+                if report is None:
+                    # alive node without a report: its reattach raced the
+                    # window close (recorded nothing) — SPARE the actor;
+                    # killing a possibly-running instance risks split
+                    # brain, and a genuinely dead worker is still caught
+                    # by the agent's report_worker_failure path
+                    continue
+                if r.get("lease_id") not in report["leases"]:
+                    lost.append(aid)
+        for aid in lost:
+            self._on_actor_worker_lost(aid, "worker lost during head outage")
+        # Verify PG bundle placements the same way, then resume pending
+        # placement work.
+        requeue_pgs = []
+        with self._lock:
+            for pg in self._pgs.values():
+                if pg["state"] not in (PGState.CREATED, PGState.PENDING,
+                                       PGState.RESCHEDULING):
+                    continue
+                drop = []
+                for idx, nid in list(pg["bundle_locations"].items()):
+                    node = self._nodes.get(nid)
+                    if node is None or not node["alive"]:
+                        drop.append(idx)
+                        continue
+                    report = self._reattached.get(nid)
+                    if report is not None and idx not in report[
+                        "bundles"
+                    ].get(pg["pg_id"], ()):
+                        drop.append(idx)
+                if drop:
+                    self._apply("pg_drop_locations", pg["pg_id"], drop)
+                    if pg["state"] == PGState.CREATED:
+                        self._apply(
+                            "pg_update", pg["pg_id"],
+                            {"state": PGState.PENDING},
+                        )
+                if pg["state"] in (PGState.PENDING, PGState.RESCHEDULING):
+                    requeue_pgs.append(pg["pg_id"])
+        for pid in requeue_pgs:
+            self._sched_enqueue(("pg", pid))
+        self._sched_enqueue(("kick",))
+        with self._lock:
+            reattached = len(self._reattached)
+            self._reattached.clear()  # reports are consumed; window over
+        self.publish("head", {"event": "reconciled",
+                              "stale_nodes": stale_nodes})
+        logger.info(
+            "reconciliation complete: %d nodes re-attached, %d stale "
+            "nodes GC'd, %d actors failed over, %d PGs re-placing",
+            reattached, len(stale_nodes), len(lost), len(requeue_pgs),
+        )
+
+    def rpc_reattach_node(self, conn, node_info: Dict[str, Any],
+                          leases: Optional[Dict[str, Dict[str, Any]]] = None,
+                          bundles: Optional[Dict[str, List[int]]] = None,
+                          workers: Optional[List[str]] = None):
+        """A live agent re-asserts its state after a head restart (or
+        after the store otherwise lost its registration). Returns the
+        normal registration payload plus store-managed lease_ids the
+        agent should release (orphans no live actor references)."""
+        node_id = node_info["node_id"]
+        leases = leases or {}
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None and not node["alive"]:
+                return {"ok": False}  # explicitly declared dead: agent exits
+            known = node is not None
+            self._apply("node_register", node_id, dict(node_info))
+            self._apply("node_runtime", node_id, {
+                "last_heartbeat": time.monotonic(),
+                "resources_available": dict(node_info["resources_total"]),
+                "reconciled": True,
+            })
+            self._view_version += 1
+            if self._recovering:
+                # the report only feeds _finalize_reconciliation; post-
+                # window reattaches (store lost a record) must not
+                # accumulate in it forever
+                if node_id not in self._reattached:
+                    self._reattached_total += 1
+                self._reattached[node_id] = {
+                    "leases": set(leases),
+                    "bundles": {
+                        pg_id: {int(i) for i in idxs}
+                        for pg_id, idxs in (bundles or {}).items()
+                    },
+                    "workers": list(workers or ()),
+                }
+            referenced = {
+                r.get("lease_id") for r in self._actors.values()
+                if r["state"] in (ActorState.ALIVE,
+                                  ActorState.PENDING_CREATION)
+            }
+            release = [
+                lid for lid, info in leases.items()
+                if not info.get("bound") and lid not in referenced
+            ]
+        logger.info(
+            "node %s re-attached (%d leases, %d PGs, %d workers; "
+            "%d orphan leases to release)",
+            node_id[:8], len(leases), len(bundles or {}),
+            len(workers or ()), len(release),
+        )
+        if not known:
+            self.publish(
+                "node", {"event": "added", "node": self._public_node(node_id)}
+            )
+        self._sched_enqueue(("kick",))
+        return {
+            "ok": True,
+            "config_snapshot": config.snapshot(),
+            "session_id": self.session_id,
+            "release_leases": release,
+        }
+
+    def rpc_ha_status(self, conn):
+        """HA/failover introspection for `rt status` and tests."""
+        with self._lock:
+            st: Dict[str, Any] = {
+                "enabled": self._ha is not None,
+                "recovering": self._recovering,
+                "reconcile_remaining_s": (
+                    max(0.0, self._reconcile_deadline - time.monotonic())
+                    if self._recovering else 0.0
+                ),
+                "unreconciled_nodes": [
+                    nid for nid, n in self._nodes.items()
+                    if n.get("alive") and not n.get("reconciled", True)
+                ],
+                "reattached_nodes": self._reattached_total,
+            }
+            if self._ha is not None:
+                st.update(self._ha.stats())
+        return st
 
     @property
     def address(self) -> str:
@@ -236,6 +648,8 @@ class ControlStore:
             ).start()
 
     def _confirm_node_death(self, node_id: str) -> None:
+        if self._recovering:
+            return  # mid-reattach churn must not kill a returning node
         t_break = time.monotonic()
         grace = 2.5 * config.health_check_period_s
         while time.monotonic() - t_break < grace:
@@ -264,11 +678,9 @@ class ControlStore:
 
     def rpc_kv_put(self, conn, ns: str, key: str, value: bytes, overwrite: bool = True):
         with self._lock:
-            table = self._kv.setdefault(ns, {})
-            if not overwrite and key in table:
+            if not overwrite and key in self._kv.get(ns, {}):
                 return False
-            table[key] = value
-            self._dirty = True
+            self._apply("kv_put", ns, key, value)
             self._kv_cv.notify_all()
             return True
 
@@ -294,8 +706,9 @@ class ControlStore:
 
     def rpc_kv_del(self, conn, ns: str, key: str):
         with self._lock:
-            self._dirty = True
-            return self._kv.get(ns, {}).pop(key, None) is not None
+            if key not in self._kv.get(ns, {}):
+                return False
+            return self._apply("kv_del", ns, key)
 
     def rpc_kv_keys(self, conn, ns: str, prefix: str = ""):
         with self._lock:
@@ -303,16 +716,11 @@ class ControlStore:
 
     def rpc_kv_del_prefix(self, conn, ns: str, prefix: str = ""):
         with self._lock:
-            self._dirty = True
-            table = self._kv.get(ns)
-            if table is None:
+            if not any(
+                k.startswith(prefix) for k in self._kv.get(ns, ())
+            ):
                 return 0
-            doomed = [k for k in table if k.startswith(prefix)]
-            for k in doomed:
-                del table[k]
-            if not table and prefix == "":
-                self._kv.pop(ns, None)
-            return len(doomed)
+            return self._apply("kv_del_prefix", ns, prefix)
 
     # ------------------------------------------------------------------
     # nodes (reference GcsNodeManager + health checks + syncer)
@@ -321,12 +729,12 @@ class ControlStore:
     def rpc_register_node(self, conn, node_info: Dict[str, Any]):
         node_id = node_info["node_id"]
         with self._lock:
-            self._nodes[node_id] = {
-                **node_info,
-                "alive": True,
+            self._apply("node_register", node_id, dict(node_info))
+            self._apply("node_runtime", node_id, {
                 "last_heartbeat": time.monotonic(),
                 "resources_available": dict(node_info["resources_total"]),
-            }
+                "reconciled": True,
+            })
             self._view_version += 1
         logger.info("node %s registered at %s", node_id[:8], node_info["address"])
         self.publish("node", {"event": "added", "node": self._public_node(node_id)})
@@ -346,23 +754,38 @@ class ControlStore:
         full beat."""
         with self._lock:
             node = self._nodes.get(node_id)
-            if node is None or not node["alive"]:
+            if node is None:
+                # The store has no record of this live agent (restarted
+                # head with no/lost log): ask it to re-attach rather than
+                # telling it to die.
+                return {"ok": False, "reattach": True}
+            if not node["alive"]:
                 return {"ok": False}  # tells a zombie agent to exit
             # Tag the transport so a broken agent connection fast-paths
             # failure detection (reference: GCS treats the raylet channel
             # break as a death signal, not just missed heartbeats).
             conn.node_id = node_id
-            node["last_heartbeat"] = time.monotonic()
+            if not node.get("reconciled", True):
+                # restored-from-log record: the agent must re-assert its
+                # leases/bundles/workers before scheduling trusts the node
+                self._apply("node_runtime", node_id,
+                            {"last_heartbeat": time.monotonic()})
+                return {"ok": True, "reattach": True}
+            runtime: Dict[str, Any] = {"last_heartbeat": time.monotonic()}
             if resources_available is None:
+                self._apply("node_runtime", node_id, runtime)
                 if node.get("view_version") != view_version:
                     return {"ok": True, "resync": True}
                 return {"ok": True}
-            node["resources_available"] = resources_available
-            node["pending_leases"] = pending_leases
-            node["active_leases"] = active_leases
-            node["view_version"] = view_version
+            runtime.update({
+                "resources_available": resources_available,
+                "pending_leases": pending_leases,
+                "active_leases": active_leases,
+                "view_version": view_version,
+            })
             if extra:
-                node.update(extra)
+                runtime.update(extra)
+            self._apply("node_runtime", node_id, runtime)
             self._view_version += 1
         return {"ok": True}
 
@@ -432,6 +855,8 @@ class ControlStore:
 
     def _health_loop(self) -> None:
         while not self._stopped.wait(config.health_check_period_s):
+            if self._recovering:
+                continue  # reconciliation window: agents get time to return
             now = time.monotonic()
             dead = []
             with self._lock:
@@ -442,15 +867,18 @@ class ControlStore:
                 logger.warning("node %s missed heartbeats; marking dead", nid[:8])
                 self._mark_node_dead(nid, "heartbeat timeout")
 
-    def _mark_node_dead(self, node_id: str, reason: str) -> None:
+    def _mark_node_dead(self, node_id: str, reason: str,
+                        only_if_unreconciled: bool = False) -> None:
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node["alive"]:
                 return
-            node["alive"] = False
+            if only_if_unreconciled and node.get("reconciled", True):
+                return  # re-attached between the stale scan and this call
+            self._apply("node_dead", node_id)
             self._view_version += 1
             affected_actors = [
-                a for a in self._actors.values()
+                a["actor_id"] for a in self._actors.values()
                 if a.get("node_id") == node_id
                 and a["state"] in (ActorState.ALIVE, ActorState.PENDING_CREATION)
             ]
@@ -469,13 +897,14 @@ class ControlStore:
                     if nid == node_id
                 ]
                 if lost:
-                    for i in lost:
-                        del pg["bundle_locations"][i]
-                    pg["state"] = PGState.PENDING
+                    self._apply("pg_drop_locations", pg["pg_id"], lost)
+                    self._apply(
+                        "pg_update", pg["pg_id"], {"state": PGState.PENDING}
+                    )
                     replaced_pgs.append(pg["pg_id"])
         self.publish("node", {"event": "removed", "node_id": node_id, "reason": reason})
-        for actor in affected_actors:
-            self._on_actor_worker_lost(actor["actor_id"], f"node died: {reason}")
+        for actor_id in affected_actors:
+            self._on_actor_worker_lost(actor_id, f"node died: {reason}")
         for pg_id in replaced_pgs:
             self._sched_enqueue(("pg", pg_id))
 
@@ -485,25 +914,12 @@ class ControlStore:
 
     def rpc_register_job(self, conn, driver_address: str, metadata: Dict[str, Any]):
         with self._lock:
-            job_id = JobID.from_int(self._next_job)
-            self._next_job += 1
-            self._jobs[job_id.hex()] = {
-                "job_id": job_id.hex(),
-                "driver_address": driver_address,
-                "metadata": metadata,
-                "start_time": time.time(),
-                "alive": True,
-            }
-            self._dirty = True
-        return job_id.hex()
+            return self._apply("job_add", driver_address, metadata, time.time())
 
     def rpc_finish_job(self, conn, job_id: str):
         with self._lock:
-            job = self._jobs.get(job_id)
-            if job:
-                job["alive"] = False
-                job["end_time"] = time.time()
-                self._dirty = True
+            if job_id in self._jobs:
+                self._apply("job_finish", job_id, time.time())
         # Non-detached actors owned by the job die with it.
         with self._lock:
             doomed = [
@@ -543,7 +959,6 @@ class ControlStore:
                         raise ValueError(
                             f"actor name {name!r} already taken in namespace {ns!r}"
                         )
-                self._named_actors[key] = actor_id
             record = {
                 **spec,
                 "state": ActorState.PENDING_CREATION,
@@ -552,7 +967,7 @@ class ControlStore:
                 "worker_address": None,
                 "death_cause": None,
             }
-            self._actors[actor_id] = record
+            self._apply("actor_register", record)
         self._sched_enqueue(("actor", actor_id))
         return True
 
@@ -638,6 +1053,12 @@ class ControlStore:
 
     def _process_sched(self, item: tuple) -> None:
         kind = item[0]
+        if self._recovering and kind in ("actor", "pg"):
+            # reconciliation window: placement decisions wait until live
+            # agents have re-asserted their leases/bundles — scheduling
+            # against a half-reconciled view would double-place actors
+            self._sched_retry(item, tuple(item[:2]))
+            return
         if kind == "actor":
             self._sched_actor_place(item[1])
         elif kind == "actor_lease":
@@ -790,10 +1211,11 @@ class ControlStore:
             except RpcError:
                 pass
             with self._lock:
-                record = self._actors.get(actor_id)
-                if record is not None:
-                    record["state"] = ActorState.DEAD
-                    record["death_cause"] = str(created.get("error"))
+                if actor_id in self._actors:
+                    self._apply("actor_update", actor_id, {
+                        "state": ActorState.DEAD,
+                        "death_cause": str(created.get("error")),
+                    })
             self._sched_backoff.pop(("actor", actor_id), None)
             self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
             self.publish("actor", self._public_actor(actor_id))
@@ -809,11 +1231,13 @@ class ControlStore:
                 dead = True
             else:
                 dead = False
-                record["state"] = ActorState.ALIVE
-                record["node_id"] = node_id
-                record["worker_address"] = lease["worker_address"]
-                record["lease_id"] = lease["lease_id"]
-                record["agent_address"] = agent_addr
+                self._apply("actor_update", actor_id, {
+                    "state": ActorState.ALIVE,
+                    "node_id": node_id,
+                    "worker_address": lease["worker_address"],
+                    "lease_id": lease["lease_id"],
+                    "agent_address": agent_addr,
+                })
         if dead:
             try:
                 self._agents.get(agent_addr).call_oneway(
@@ -910,8 +1334,9 @@ class ControlStore:
             agent_addr = record.get("agent_address")
             lease_id = record.get("lease_id")
             if no_restart:
-                record["state"] = ActorState.DEAD
-                record["death_cause"] = reason
+                self._apply("actor_update", actor_id, {
+                    "state": ActorState.DEAD, "death_cause": reason,
+                })
         if worker_addr:
             try:
                 self._workers.get(worker_addr).call_oneway("exit_worker")
@@ -939,14 +1364,17 @@ class ControlStore:
                 return
             max_restarts = record.get("max_restarts", 0)
             if max_restarts == -1 or record["num_restarts"] < max_restarts:
-                record["num_restarts"] += 1
-                record["state"] = ActorState.RESTARTING
-                record["worker_address"] = None
-                record["node_id"] = None
+                self._apply("actor_update", actor_id, {
+                    "num_restarts": record["num_restarts"] + 1,
+                    "state": ActorState.RESTARTING,
+                    "worker_address": None,
+                    "node_id": None,
+                })
                 restart = True
             else:
-                record["state"] = ActorState.DEAD
-                record["death_cause"] = reason
+                self._apply("actor_update", actor_id, {
+                    "state": ActorState.DEAD, "death_cause": reason,
+                })
                 restart = False
         self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
         self.publish("actor", self._public_actor(actor_id))
@@ -980,7 +1408,7 @@ class ControlStore:
                                    strategy: str, name: Optional[str] = None,
                                    job_id: Optional[str] = None):
         with self._lock:
-            self._pgs[pg_id] = {
+            self._apply("pg_add", {
                 "pg_id": pg_id,
                 "bundles": bundles,
                 "strategy": strategy,
@@ -989,7 +1417,7 @@ class ControlStore:
                 "state": PGState.PENDING,
                 # bundle index -> node_id hex
                 "bundle_locations": {},
-            }
+            })
         self._sched_enqueue(("pg", pg_id))
         return True
 
@@ -1021,7 +1449,7 @@ class ControlStore:
                 pg = self._pgs.get(pg_id)
                 if pg is None or pg["state"] == PGState.REMOVED:
                     return
-                pg["state"] = PGState.CREATED
+                self._apply("pg_update", pg_id, {"state": PGState.CREATED})
             self._sched_backoff.pop(key, None)
             self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.CREATED})
             return
@@ -1087,7 +1515,7 @@ class ControlStore:
             pg = self._pgs.get(pg_id)
             if pg is None:
                 return False
-            pg["bundle_locations"].update(placement)
+            self._apply("pg_merge_locations", pg_id, placement)
         # go around once more: recompute missing (usually empty -> CREATED)
         return True
 
@@ -1128,7 +1556,7 @@ class ControlStore:
             pg = self._pgs.get(pg_id)
             if pg is None:
                 return False
-            pg["state"] = PGState.REMOVED
+            self._apply("pg_update", pg_id, {"state": PGState.REMOVED})
             locations = dict(pg["bundle_locations"])
             view = self._cluster_view_locked()
         for node_id in set(locations.values()):

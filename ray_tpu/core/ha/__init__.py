@@ -1,0 +1,14 @@
+"""Head fault tolerance (HA) subsystem.
+
+Parity: the reference's Redis-backed GCS fault tolerance
+(gcs_server FT mode: gcs_table_storage over a durable store_client,
+full state rebuild on restart, raylet reconnect). Here the durable
+store is a write-ahead log + periodic snapshot on the local
+filesystem (``wal.py``), the control store replays it through its
+mutation choke point, and the cluster re-attaches through the
+heartbeat/reattach protocol (``control_store.py`` /
+``node_agent.py``) plus the head-address resolver (``reattach.py``).
+"""
+
+from ray_tpu.core.ha.reattach import head_resolver, write_head_address  # noqa: F401
+from ray_tpu.core.ha.wal import FileBackend, HAState  # noqa: F401

@@ -1,0 +1,55 @@
+"""Head address discovery for cluster re-attach.
+
+When the head restarts at a NEW address, live agents/workers/drivers
+need a rendezvous to find it. The control store publishes its address to
+``config.ha_head_address_file`` (a path on storage the cluster shares —
+on a TPU pod, the NFS/persistent-disk mount the head already uses for
+its WAL); RPC clients built with :func:`head_resolver` re-read it on
+every reconnect attempt. With the flag unset (the default), clients
+simply re-dial the address they already know — the same-address restart
+case needs no rendezvous at all.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+from ray_tpu.utils.config import config
+
+logger = logging.getLogger(__name__)
+
+
+def write_head_address(address: str) -> None:
+    """Atomically publish the head's current address (no-op when the
+    address-file flag is unset)."""
+    path = str(config.ha_head_address_file)
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(address)
+        os.replace(tmp, path)
+    except OSError:
+        logger.exception("cannot publish head address to %s", path)
+
+
+def read_head_address() -> Optional[str]:
+    path = str(config.ha_head_address_file)
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            addr = f.read().strip()
+        return addr or None
+    except OSError:
+        return None
+
+
+def head_resolver() -> Callable[[], Optional[str]]:
+    """Resolver for RpcClients pointed at the control store: returns the
+    currently-published head address, or None to keep the known one."""
+    return read_head_address

@@ -1,0 +1,279 @@
+"""Durable control-plane log: snapshot + write-ahead log + compaction.
+
+Parity: the reference's gcs_table_storage over a durable StoreClient
+(Redis FT mode). The control store funnels every durable state mutation
+through one choke point (``ControlStore._apply``) which appends the
+fully-resolved operation here; recovery loads the last snapshot and
+replays the WAL tail through the same mutation functions, so the
+restored tables are byte-identical to the pre-crash state.
+
+Backends are pluggable behind the small ``load_snapshot / wal_append /
+...`` surface; ``FileBackend`` is the built-in local-filesystem one
+(on a TPU pod the head's persistent disk or an NFS export — the
+TPU-native stand-in for the reference's Redis deployment).
+
+WAL frame: ``[4-byte LE crc32][4-byte LE length][pickled (seq, op,
+args)]``. Replay stops at the first corrupt or truncated frame (a torn
+tail write from the crash is expected and harmless — that mutation
+never acked). The monotonic ``seq`` makes compaction crash-atomic: the
+snapshot records the last folded seq, and recovery skips WAL frames at
+or below it — a crash between snapshot rename and WAL truncation
+cannot double-apply ops.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<II")  # crc32, payload length
+
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotCorruptError(Exception):
+    """The snapshot file exists but cannot be read."""
+
+
+class FileBackend:
+    """Snapshot at ``path``, WAL at ``path + ".wal"``."""
+
+    def __init__(self, path: str):
+        self.snapshot_path = path
+        self.wal_path = path + ".wal"
+        self._wal_f = None
+
+    # -- snapshot --
+
+    def load_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Load the snapshot; None means ABSENT. A present-but-unreadable
+        snapshot raises SnapshotCorruptError — conflating the two would
+        let recovery replay the post-compaction WAL tail onto empty
+        tables and present partial state as authoritative."""
+        if not os.path.exists(self.snapshot_path):
+            return None
+        try:
+            with open(self.snapshot_path, "rb") as f:
+                return pickle.load(f)
+        except Exception as e:  # noqa: BLE001
+            raise SnapshotCorruptError(
+                f"HA snapshot unreadable: {self.snapshot_path}: {e}"
+            ) from e
+
+    def quarantine(self) -> None:
+        """Set aside the snapshot+WAL pair (suffix .corrupt) so a fresh
+        start never destroys the evidence of what it could not read."""
+        for path in (self.snapshot_path, self.wal_path):
+            if os.path.exists(path):
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:
+                    logger.exception("cannot quarantine %s", path)
+        if self._wal_f is not None:
+            try:
+                self._wal_f.close()
+            except OSError:
+                pass
+            self._wal_f = None
+
+    def write_snapshot(self, payload: Dict[str, Any]) -> None:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(self.snapshot_path)), exist_ok=True
+        )
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snapshot_path)
+
+    # -- WAL --
+
+    def wal_iter(self) -> Iterator[Tuple[int, str, tuple]]:
+        """Yield (seq, op, args) records; stop silently at a torn/corrupt
+        tail."""
+        if not os.path.exists(self.wal_path):
+            return
+        with open(self.wal_path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    return
+                crc, length = _HDR.unpack(hdr)
+                payload = f.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    logger.warning(
+                        "WAL %s: torn/corrupt tail record, stopping replay",
+                        self.wal_path,
+                    )
+                    return
+                try:
+                    yield pickle.loads(payload)
+                except Exception:  # noqa: BLE001
+                    logger.exception("WAL record unpickle failed; stopping")
+                    return
+
+    def wal_append(self, record: Tuple[int, str, tuple],
+                   fsync: bool = False) -> None:
+        if self._wal_f is None:
+            os.makedirs(
+                os.path.dirname(os.path.abspath(self.wal_path)), exist_ok=True
+            )
+            self._wal_f = open(self.wal_path, "ab")
+        payload = pickle.dumps(record)
+        self._wal_f.write(_HDR.pack(zlib.crc32(payload), len(payload)) + payload)
+        self._wal_f.flush()
+        if fsync:
+            os.fsync(self._wal_f.fileno())
+
+    def wal_reset(self) -> None:
+        """Truncate the WAL (called right after a snapshot is durable)."""
+        if self._wal_f is not None:
+            self._wal_f.close()
+            self._wal_f = None
+        os.makedirs(
+            os.path.dirname(os.path.abspath(self.wal_path)), exist_ok=True
+        )
+        with open(self.wal_path, "wb"):
+            pass
+
+    def close(self) -> None:
+        if self._wal_f is not None:
+            try:
+                self._wal_f.close()
+            except OSError:
+                pass
+            self._wal_f = None
+
+
+class HAState:
+    """Snapshot + WAL lifecycle for one control store.
+
+    The caller (control store) serializes calls: ``append`` runs under the
+    store lock, so records are totally ordered and a compaction snapshot
+    taken inline is consistent with the log position.
+    """
+
+    def __init__(
+        self,
+        backend: FileBackend,
+        compact_entries: int = 1000,
+        fsync: bool = False,
+    ):
+        self.backend = backend
+        self.compact_entries = max(1, int(compact_entries))
+        self.fsync = fsync
+        self.epoch = 0  # number of recoveries this store's state survived
+        self.seq = 0  # last op sequence number handed out
+        self.meta: Dict[str, Any] = {}
+        self._since_snapshot = 0
+        self._appended = 0
+        self._compactions = 0
+        self._replayed = 0
+
+    # -- recovery --
+
+    def recover(self) -> Tuple[Optional[Dict[str, Any]], List[Tuple[str, tuple]]]:
+        """Return (snapshot tables or None, WAL tail records). Frames
+        whose seq the snapshot already folded in are skipped — they are
+        the residue of a crash between snapshot rename and WAL reset.
+
+        A corrupt (present-but-unreadable) snapshot quarantines the
+        whole snapshot+WAL pair and starts fresh: replaying the WAL tail
+        alone would silently present partial state as authoritative,
+        and truncation at start() would destroy the evidence."""
+        try:
+            snap = self.backend.load_snapshot()
+        except SnapshotCorruptError:
+            logger.exception(
+                "HA snapshot corrupt — quarantining snapshot+WAL "
+                "(.corrupt) and starting from empty state"
+            )
+            self.backend.quarantine()
+            self.epoch += 1  # a (degraded) recovery still happened
+            return None, []
+        tables = None
+        snap_seq = 0
+        if snap is not None:
+            self.epoch = int(snap.get("epoch", 0))
+            snap_seq = int(snap.get("seq", 0))
+            self.meta = dict(snap.get("meta", {}))
+            tables = snap.get("tables")
+        self.seq = snap_seq
+        records = []
+        for rec in self.backend.wal_iter():
+            seq, op, args = rec
+            if seq <= snap_seq:
+                continue  # already folded into the snapshot
+            self.seq = max(self.seq, seq)
+            records.append((op, args))
+        self._replayed = len(records)
+        if tables is not None or records:
+            self.epoch += 1
+        return tables, records
+
+    def start(self, state_fn: Callable[[], Dict[str, Any]],
+              meta: Optional[Dict[str, Any]] = None) -> None:
+        """Finish recovery: persist a fresh snapshot of the replayed state
+        and truncate the WAL, so the next crash replays from here."""
+        if meta is not None:
+            self.meta.update(meta)
+        self._snapshot(state_fn)
+
+    # -- logging --
+
+    def append(self, op: str, args: tuple,
+               state_fn: Callable[[], Dict[str, Any]]) -> None:
+        """Log one op. Called BEFORE the mutation is applied (an append
+        failure must leave memory and log consistent), so the compaction
+        check runs first: the snapshot folds only ops that are already
+        applied, and the fresh record lands in the reset WAL with
+        seq > snapshot seq.
+
+        Compaction is inline, under the caller's store lock: the stall is
+        pickle+fsync of the durable tables, every compact_entries ops —
+        single-digit ms at this repo's scale envelope. Tune
+        RT_HA_WAL_COMPACT_ENTRIES upward if the control plane carries
+        MB-scale KV blobs and the periodic pause matters."""
+        if self._since_snapshot >= self.compact_entries:
+            self._snapshot(state_fn)
+            self._compactions += 1
+        self.seq += 1
+        self.backend.wal_append((self.seq, op, args), fsync=self.fsync)
+        self._appended += 1
+        self._since_snapshot += 1
+
+    def _snapshot(self, state_fn: Callable[[], Dict[str, Any]]) -> None:
+        self.backend.write_snapshot({
+            "version": SNAPSHOT_VERSION,
+            "epoch": self.epoch,
+            "seq": self.seq,
+            "meta": dict(self.meta),
+            "tables": state_fn(),
+        })
+        self.backend.wal_reset()
+        self._since_snapshot = 0
+
+    def close(self, state_fn: Optional[Callable[[], Dict[str, Any]]] = None) -> None:
+        if state_fn is not None:
+            try:
+                self._snapshot(state_fn)
+            except OSError:
+                logger.exception("final HA snapshot failed")
+        self.backend.close()
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "epoch": self.epoch,
+            "wal_appended": self._appended,
+            "wal_since_snapshot": self._since_snapshot,
+            "wal_replayed": self._replayed,
+            "compactions": self._compactions,
+            "snapshot_path": self.backend.snapshot_path,
+        }

@@ -1,0 +1,132 @@
+"""Standalone head entrypoint — control store + gateway as their own
+process, restartable without taking the cluster down.
+
+Parity: the gcs_server binary (src/ray/gcs/gcs_server_main.cc) in its
+FT deployment mode: run the head under a supervisor with a durable log
+(--persist), and a crash/restart is a blip — the store rebuilds from
+snapshot+WAL (core/ha/), live node agents re-attach during the
+reconciliation window, and drivers/workers ride it out via retrying
+RPC clients.
+
+`rt head start` wraps this module; `rt head-restart` sends the
+``head_restart`` RPC registered here, which re-execs the process with
+the same argv (same port, same durable log) — a real process bounce,
+used both for ops drills and the failover tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+import time
+import uuid
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="head_main")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--port", type=int, default=0,
+        help="control-store port (fix it for restart-in-place)",
+    )
+    parser.add_argument("--session-id", default=None)
+    parser.add_argument(
+        "--persist", default=None,
+        help="durable-log base path (snapshot at PATH, WAL at PATH.wal)",
+    )
+    parser.add_argument(
+        "--address-file", default=None,
+        help="publish the head address here (cluster re-attach rendezvous)",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[head {os.getpid()}] %(levelname)s %(name)s: %(message)s",
+    )
+
+    from ray_tpu.utils.config import config
+
+    snapshot = os.environ.get("RT_CONFIG_SNAPSHOT")
+    if snapshot:
+        config.load_snapshot(snapshot)
+    if args.address_file:
+        config.set("ha_head_address_file", args.address_file)
+
+    from ray_tpu.core.control_store import ControlStore
+    from ray_tpu.utils.gateway import Gateway
+
+    session_id = args.session_id or uuid.uuid4().hex
+    control = ControlStore(
+        session_id, host=args.host, port=args.port,
+        persistence_path=args.persist,
+    )
+    control.start()
+    gateway = Gateway(control.address)
+    gateway.start()
+
+    state = {"stop": False, "restart": False}
+
+    def rpc_head_restart(conn):
+        """Controlled head bounce: final snapshot, then re-exec with the
+        same argv — same port, same durable log, fresh process."""
+        if not args.persist:
+            raise RuntimeError(
+                "head-restart requires a durable log (--persist)"
+            )
+        if args.port == 0:
+            raise RuntimeError(
+                "head-restart requires a fixed --port (an ephemeral port "
+                "would strand re-attaching clients)"
+            )
+        state["restart"] = True
+        return True
+
+    control._server.register("head_restart", rpc_head_restart)
+
+    print(
+        json.dumps({
+            "address": control.address,
+            "gateway_address": gateway.address,
+            "session_id": control.session_id,
+            "pid": os.getpid(),
+        }),
+        flush=True,
+    )
+
+    def handle(*_):
+        state["stop"] = True
+
+    signal.signal(signal.SIGTERM, handle)
+    signal.signal(signal.SIGINT, handle)
+    while not (state["stop"] or state["restart"]):
+        time.sleep(0.2)
+    restart = state["restart"]
+    if restart:
+        # let the head_restart reply flush before tearing the server down
+        time.sleep(0.2)
+    try:
+        gateway.stop()
+    except Exception:  # noqa: BLE001 — teardown path
+        pass
+    control.stop()
+    if restart:
+        logging.getLogger(__name__).info("re-exec for head restart")
+        reexec = [
+            "--host", args.host, "--port", str(args.port),
+            "--session-id", control.session_id,
+        ]
+        if args.persist:
+            reexec += ["--persist", args.persist]
+        if args.address_file:
+            reexec += ["--address-file", args.address_file]
+        os.execv(sys.executable, [sys.executable, "-m",
+                                  "ray_tpu.core.head_main", *reexec])
+
+
+if __name__ == "__main__":
+    main()

@@ -44,10 +44,10 @@ logger = logging.getLogger(__name__)
 
 class _Worker:
     __slots__ = ("worker_id", "address", "pid", "proc", "state", "lease_id",
-                 "kind", "env_hash")
+                 "kind", "env_hash", "log_base")
 
     def __init__(self, worker_id, address, pid, proc, kind="cpu",
-                 env_hash=""):
+                 env_hash="", log_base=""):
         self.worker_id = worker_id
         self.address = address
         self.pid = pid
@@ -55,6 +55,7 @@ class _Worker:
         self.state = "idle"  # idle | leased | dead
         self.lease_id: Optional[str] = None
         self.kind = kind  # "cpu" | "tpu"
+        self.log_base = log_base  # stdout/.err capture path prefix
         # Pool is keyed by (kind, env_hash), the way the reference keys
         # its pool by language + runtime-env hash (worker_pool.h:280):
         # repeated use of one runtime env lands on warm workers that
@@ -123,7 +124,11 @@ class NodeAgent:
             int(config.object_store_memory_mb) * 1024 * 1024,
         )
 
-        self._control = RpcClient(control_address, name="agent->cs")
+        from ray_tpu.core.ha import head_resolver
+
+        self._control = RpcClient(
+            control_address, name="agent->cs", resolver=head_resolver()
+        )
         self._stopped = threading.Event()
         self._threads: List[threading.Thread] = []
         # Data-plane listener (object transfer): whole segments stream
@@ -353,6 +358,13 @@ class NodeAgent:
                         resources_available=None, timeout_s=5.0,
                         view_version=version,
                     )
+                    if reply.get("reattach"):
+                        # head restarted: re-assert our state (or die if
+                        # the store has explicitly declared us dead)
+                        if not self._reattach_to_head():
+                            return
+                        last_sent = None
+                        continue
                     if reply.get("resync"):
                         last_sent = None  # store lost our view: full next
                     if not reply.get("ok"):
@@ -369,6 +381,11 @@ class NodeAgent:
                     extra={"pending_shapes": shapes}, view_version=version,
                 )
                 last_sent = payload
+                if reply.get("reattach"):
+                    if not self._reattach_to_head():
+                        return
+                    last_sent = None
+                    continue
                 if not reply.get("ok"):
                     self._declared_dead()
                     return
@@ -385,6 +402,63 @@ class NodeAgent:
         self.stop()
         if self.standalone:
             os._exit(1)
+
+    def _reattach_to_head(self) -> bool:
+        """Re-assert this node's full state with a restarted head (HA
+        reconciliation; parity: raylet reconnect under GCS FT). Reports
+        live leases (tagged owner-bound vs store-managed), committed PG
+        bundles, and pooled workers; the store replies with orphaned
+        store-managed leases to release. Returns False when the store
+        refuses (we are declared dead) — the caller must exit."""
+        with self._lock:
+            leases = {
+                lid: {"bound": info.get("conn_id") is not None}
+                for lid, info in self._leases.items()
+            }
+            bundles = {
+                pg_id: sorted(rec["bundles"])
+                for pg_id, rec in self._bundles.items()
+                if rec["state"] == "committed"
+            }
+            workers = [
+                w.address for w in self._workers.values()
+                if w.state != "dead"
+            ]
+            node_info = {
+                "node_id": self.node_id.hex(),
+                "address": self.address,
+                "resources_total": dict(self.resources_total),
+                "labels": dict(self.labels),
+                "object_store_capacity": self.store.usage()[1],
+            }
+        try:
+            reply = self._control.call(
+                "reattach_node", node_info=node_info, leases=leases,
+                bundles=bundles, workers=workers, retryable=True,
+            )
+        except RpcError:
+            logger.warning("re-attach RPC failed; retrying on next beat")
+            return True  # transient: keep heartbeating, reattach re-asked
+        if not reply.get("ok"):
+            self._declared_dead()
+            return False
+        config.load_snapshot(reply["config_snapshot"])
+        self.control_address = self._control.address
+        orphans = reply.get("release_leases") or []
+        for lid in orphans:
+            # store-managed leases no live actor references (the head died
+            # mid-creation): kill the half-created worker so the actor's
+            # reschedule cannot double-place
+            try:
+                self.rpc_release_worker(None, lid, kill=True)
+            except Exception:  # noqa: BLE001 — cleanup path
+                logger.exception("orphan lease %s release failed", lid[:8])
+        logger.info(
+            "re-attached to head at %s (%d leases kept, %d orphans "
+            "released)", self._control.address, len(leases) - len(orphans),
+            len(orphans),
+        )
+        return True
 
     # ------------------------------------------------------------------
     # memory monitor / OOM killer (reference C19: MemoryMonitor
@@ -498,6 +572,7 @@ class NodeAgent:
         stdout.close()
         stderr.close()
         _PROC_REGISTRY[proc.pid] = proc
+        _PROC_LOGS[proc.pid] = log_base
         with self._lock:
             self._pending_spawns += 1
         threading.Thread(
@@ -509,6 +584,7 @@ class NodeAgent:
         dead: Optional[_Worker] = None
         if _PROC_REGISTRY.pop(proc.pid, None) is not None:
             # Died before ever registering: release the spawn slot.
+            _PROC_LOGS.pop(proc.pid, None)
             with self._lock:
                 self._pending_spawns = max(0, self._pending_spawns - 1)
                 self._cv.notify_all()
@@ -546,7 +622,8 @@ class NodeAgent:
         with self._lock:
             self._pending_spawns = max(0, self._pending_spawns - 1)
             w = _Worker(worker_id, address, pid, _PROC_REGISTRY.pop(pid, None),
-                        kind=kind, env_hash=env_hash)
+                        kind=kind, env_hash=env_hash,
+                        log_base=_PROC_LOGS.pop(pid, ""))
             self._workers[worker_id] = w
             self._cv.notify_all()
         # a fresh idle worker unparks zero-wait lease retries just like
@@ -1100,6 +1177,56 @@ class NodeAgent:
     # introspection (state API backing)
     # ------------------------------------------------------------------
 
+    def rpc_list_objects(self, conn):
+        """Object-store inventory for `state.objects()` / `rt memory`."""
+        return {
+            "node_id": self.node_id.hex(),
+            "objects": self.store.inventory(),
+        }
+
+    def rpc_tail_worker_logs(self, conn, tail_bytes: int = 4096):
+        """Tails of every worker's captured stdout/stderr on this node
+        (`state.worker_logs()` / `rt logs`) — how a `print()` inside a
+        task reaches the driver machine. Covers dead workers too: the
+        files outlive the process."""
+        tail_bytes = max(0, min(int(tail_bytes), 1 << 20))
+        with self._lock:
+            live = {
+                os.path.basename(w.log_base): {
+                    "worker_id": wid, "pid": w.pid, "state": w.state,
+                }
+                for wid, w in self._workers.items() if w.log_base
+            }
+        logs = []
+        log_dir = os.path.join(self.temp_dir, "logs")
+        try:
+            names = sorted(os.listdir(log_dir))
+        except OSError:
+            names = []
+        for fname in names:
+            base, dot, ext = fname.rpartition(".")
+            if ext not in ("out", "err") or not base.startswith("worker-"):
+                continue
+            path = os.path.join(log_dir, fname)
+            try:
+                size = os.path.getsize(path)
+                with open(path, "rb") as f:
+                    if size > tail_bytes:
+                        f.seek(size - tail_bytes)
+                    data = f.read(tail_bytes)
+            except OSError:
+                continue
+            entry = {
+                "node_id": self.node_id.hex(),
+                "file": fname,
+                "stream": "stdout" if ext == "out" else "stderr",
+                "size": size,
+                "tail": data.decode(errors="replace"),
+            }
+            entry.update(live.get(base, {}))
+            logs.append(entry)
+        return logs
+
     def rpc_get_metrics(self, conn):
         """This process's metric registry (lease/pool/object-store series
         for a standalone agent; on the head this is the same registry the
@@ -1139,3 +1266,4 @@ class NodeAgent:
 
 
 _PROC_REGISTRY: Dict[int, subprocess.Popen] = {}
+_PROC_LOGS: Dict[int, str] = {}

@@ -23,7 +23,7 @@ import mmap
 import os
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.exceptions import ObjectLostError
 from ray_tpu.observability import core_metrics
@@ -399,6 +399,22 @@ class ShmObjectStore:
     def usage(self) -> Tuple[int, int]:
         with self._lock:
             return self._used, self._capacity
+
+    def inventory(self) -> List[Dict[str, Any]]:
+        """Per-object listing for the state API (`state.objects()` /
+        `rt memory`)."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "object_id": oid,
+                    "size": e.size,
+                    "sealed": e.sealed,
+                    "state": e.state,
+                    "idle_s": round(now - e.last_access, 3),
+                }
+                for oid, e in self._objects.items()
+            ]
 
     def spill_stats(self) -> Dict[str, int]:
         with self._lock:

@@ -134,6 +134,32 @@ class ReferenceTracker:
         while len(self._consumed_tokens) > 65536:
             self._consumed_tokens.popitem(last=False)
 
+    def stats(self) -> Dict[str, Any]:
+        """Reference-state snapshot for the state API: per-object local
+        ref counts, outstanding remote borrows, and in-flight pins with
+        their oldest age (a pin far past the TTL is a leaked borrow)."""
+        now = time.monotonic()
+        with self._lock:
+            inflight: Dict[str, Dict[str, Any]] = {}
+            for oid, created in self._escape_tokens.values():
+                rec = inflight.setdefault(
+                    oid.hex(), {"count": 0, "oldest_age_s": 0.0}
+                )
+                rec["count"] += 1
+                rec["oldest_age_s"] = max(
+                    rec["oldest_age_s"], round(now - created, 3)
+                )
+            return {
+                "address": self._worker.address,
+                "local_refs": {
+                    o.hex(): n for o, n in self._local_counts.items() if n
+                },
+                "borrows": {
+                    o.hex(): n for o, n in self._borrows.items() if n
+                },
+                "inflight_pins": inflight,
+            }
+
     def add_local_ref(self, ref: ObjectRef) -> None:
         with self._lock:
             self._local_counts[ref.id] = self._local_counts.get(ref.id, 0) + 1
@@ -369,7 +395,11 @@ class CoreWorker:
         self.server.register_raw("actor_task", self._raw_actor_task)
         self.server.start()
 
-        self.control = RpcClient(control_address, name=f"{mode}->cs")
+        from ray_tpu.core.ha import head_resolver
+
+        self.control = RpcClient(
+            control_address, name=f"{mode}->cs", resolver=head_resolver()
+        )
         self.agent = RpcClient(node_agent_address, name=f"{mode}->agent")
         self.workers = ClientPool("w2w")
         self.agents = ClientPool("w2agent")
@@ -528,6 +558,14 @@ class CoreWorker:
 
         self.control.on_push("pubsub", on_pubsub)
         self.control.call("subscribe", topics=["actor"], retryable=True)
+        # Subscriptions are connection-scoped server state: after a head
+        # bounce the (re-attached) connection must re-assert them, and the
+        # address cache may be stale for anything that moved meanwhile.
+        def resubscribe():
+            self._actor_addr_cache.clear()
+            self.control.call("subscribe", topics=["actor"], timeout_s=10.0)
+
+        self.control.add_reconnect_callback(resubscribe)
 
     def enable_gateway_mode(self) -> None:
         """Remote-driver mode (reference ray:// client,
@@ -2297,6 +2335,12 @@ class CoreWorker:
             "token": metrics_mod.PROCESS_TOKEN,
             "metrics": metrics_mod.snapshot_all(),
         }
+
+    def rpc_borrow_stats(self, conn):
+        """Owner-side reference state for `state.objects()` / `rt memory`
+        (leaked-borrow triage: an object held only by an old in-flight
+        pin is a borrow that never completed)."""
+        return self.reference_tracker.stats()
 
     def _resolve_arg(self, value: Any) -> Any:
         if isinstance(value, ObjectRef):

@@ -105,9 +105,16 @@ def cluster_status(address: Optional[str] = None) -> Dict[str, Any]:
             total[k] = total.get(k, 0.0) + v
         for k, v in st["resources_available"].items():
             avail[k] = avail.get(k, 0.0) + v
+    head_ha = None
+    try:
+        head_ha = _control(address).call("ha_status", timeout_s=5.0)
+    except Exception:  # noqa: BLE001 — status must not fail on extras
+        pass
     return {
         "nodes_alive": sum(1 for n in nodes if n.get("alive", True)),
         "nodes_dead": sum(1 for n in nodes if not n.get("alive", True)),
+        # head fault-tolerance posture (durable log / reconciliation)
+        "head_ha": head_ha,
         "resources_total": total,
         "resources_available": avail,
         "actors": {
@@ -327,6 +334,136 @@ def task_summary(address: Optional[str] = None) -> Dict[str, Any]:
             entry["queue_wait_s"] = _percentiles(rec["queue_wait_s"])
         tasks[name] = entry
     return {"tasks": tasks, "events_dropped": dropped}
+
+
+def tasks(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Task-level state listing (parity: `ray list tasks`) built from the
+    workers' task-event rings: one record per task_id with the inferred
+    state — QUEUED (submitted, not dispatched), RUNNING (dispatched, no
+    execution slice yet), FINISHED (execution slice recorded). Bounded by
+    the rings: evicted history is absent, so this is a window, not an
+    archive."""
+    events, _dropped = _collect_task_events(address)
+    recs: Dict[str, Dict[str, Any]] = {}
+
+    def rec(task_id: str) -> Dict[str, Any]:
+        return recs.setdefault(task_id, {
+            "task_id": task_id, "name": None, "state": "UNKNOWN",
+            "owner": None, "worker": None, "actor_id": None,
+            "submitted_ts_us": None, "dispatched_ts_us": None,
+            "start_ts_us": None, "dur_us": None,
+        })
+
+    for e in events:
+        if e.get("type") == "lifecycle":
+            if e["phase"] == "lease_granted":
+                continue  # lease churn, not a task transition
+            r = rec(e["task_id"])
+            r["name"] = r["name"] or e.get("name")
+            if e["phase"] == "submitted":
+                r["submitted_ts_us"] = e["ts_us"]
+                r["owner"] = e.get("worker")
+            elif e["phase"] == "dispatched":
+                r["dispatched_ts_us"] = e["ts_us"]
+        else:
+            r = rec(e["task_id"])
+            r["name"] = e["name"]
+            r["worker"] = e.get("worker")
+            r["actor_id"] = e.get("actor_id")
+            r["start_ts_us"] = e["ts_us"]
+            r["dur_us"] = e["dur_us"]
+    for r in recs.values():
+        if r["dur_us"] is not None:
+            r["state"] = "FINISHED"
+        elif r["dispatched_ts_us"] is not None:
+            r["state"] = "RUNNING"
+        elif r["submitted_ts_us"] is not None:
+            r["state"] = "QUEUED"
+    return sorted(
+        recs.values(),
+        key=lambda r: r["submitted_ts_us"] or r["start_ts_us"] or 0,
+    )
+
+
+def objects(address: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Object-level state listing (parity: `ray list objects` /
+    `ray memory`): every node's shm/spill store inventory, annotated with
+    owner-side reference state (remote borrows + in-flight pins) so a
+    leaked borrow shows up as an old pinned object. Owner-only objects
+    (small values in a memory store) appear with location "owner" when
+    they hold borrows."""
+    out: List[Dict[str, Any]] = []
+    for n in list_nodes(address):
+        if not n.get("alive", True):
+            continue
+        try:
+            reply = _pool.get(n["address"]).call("list_objects", timeout_s=10.0)
+        except RpcConnectionError:
+            _pool.drop(n["address"])
+            continue
+        except RpcError:
+            continue
+        for o in reply["objects"]:
+            out.append({**o, "node_id": reply["node_id"], "location": "store",
+                        "borrows": 0, "inflight_pins": 0, "owner": None})
+    # borrow/pin state is OBJECT-scoped (it lives at the owner): annotate
+    # every replica row of the id, not an arbitrary one — an object may
+    # sit in several nodes' stores at once
+    by_id: Dict[str, List[Dict[str, Any]]] = {}
+    for r in out:
+        by_id.setdefault(r["object_id"], []).append(r)
+    for addr in _worker_addresses(address):
+        try:
+            stats = _pool.get(addr).call("borrow_stats", timeout_s=10.0)
+        except RpcConnectionError:
+            _pool.drop(addr)
+            continue
+        except (RpcError, RuntimeError):
+            continue
+        pins = stats.get("inflight_pins", {})
+        borrows = stats.get("borrows", {})
+        for oid in set(borrows) | set(pins):
+            recs = by_id.get(oid)
+            if recs is None:
+                rec = {
+                    "object_id": oid, "node_id": None, "location": "owner",
+                    "size": None, "sealed": None, "state": "memory",
+                    "borrows": 0, "inflight_pins": 0, "owner": None,
+                }
+                by_id[oid] = [rec]
+                out.append(rec)
+                recs = [rec]
+            for rec in recs:
+                rec["owner"] = stats.get("address", addr)
+                rec["borrows"] += int(borrows.get(oid, 0))
+                pin = pins.get(oid)
+                if pin:
+                    rec["inflight_pins"] += int(pin["count"])
+                    rec["oldest_pin_age_s"] = max(
+                        rec.get("oldest_pin_age_s", 0.0),
+                        pin["oldest_age_s"],
+                    )
+    return out
+
+
+def worker_logs(address: Optional[str] = None,
+                tail_bytes: int = 4096) -> List[Dict[str, Any]]:
+    """Tails of every worker's captured stdout/stderr across the cluster
+    (`rt logs`): the minimal path from a `print()` inside a task to the
+    driver machine."""
+    logs: List[Dict[str, Any]] = []
+    for n in list_nodes(address):
+        if not n.get("alive", True):
+            continue
+        try:
+            logs.extend(_pool.get(n["address"]).call(
+                "tail_worker_logs", tail_bytes=tail_bytes, timeout_s=10.0
+            ))
+        except RpcConnectionError:
+            _pool.drop(n["address"])
+        except RpcError:
+            pass
+    return logs
 
 
 def _copy_metric(m: Dict) -> Dict:

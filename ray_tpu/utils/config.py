@@ -146,8 +146,27 @@ config.define("memory_usage_threshold", 0.95)
 config.define("memory_monitor_period_s", 1.0)
 config.define("testing_memory_usage", -1.0)
 # Control-store metadata persistence (reference C14 Redis FT mode):
-# empty = in-memory only; a path enables snapshot/restore across restarts.
+# empty = in-memory only; a path enables the HA durable log (snapshot at
+# <path>, write-ahead log at <path>.wal) so a restarted head rebuilds an
+# identical control plane (core/ha/).
 config.define("control_store_persistence_path", "")
+# HA durable-log tuning: WAL entries between snapshot compactions, and
+# whether each append fsyncs (off by default: flush-to-OS survives a head
+# process crash — the failure mode HA targets; power loss needs fsync).
+config.define("ha_wal_compact_entries", 1000)
+config.define("ha_wal_fsync", False)
+# Reconciliation window after a head restart: scheduling stays paused
+# this long (or until every restored-alive node re-attaches, whichever
+# is sooner) while agents re-assert leases/bundles/workers; nodes that
+# never re-attach are then GC'd as dead.
+config.define("ha_reconcile_window_s", 8.0)
+# Budget for a client (agent/worker/driver) to re-attach to a bounced
+# head: retryable control-store calls keep redialing (with backoff,
+# consulting ha_head_address_file for a moved head) up to this long.
+config.define("ha_reattach_max_s", 60.0)
+# Rendezvous file the head publishes its address to (shared storage);
+# empty = same-address restarts only.
+config.define("ha_head_address_file", "")
 config.define("lineage_max_bytes", 256 * 1024 * 1024)
 config.define("actor_max_restarts", 0)
 config.define("log_to_driver", True)

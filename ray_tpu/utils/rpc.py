@@ -426,7 +426,8 @@ class RpcServer:
 class RpcClient:
     """Thread-safe client multiplexing calls over one connection."""
 
-    def __init__(self, address: str, name: str = "client"):
+    def __init__(self, address: str, name: str = "client",
+                 resolver: Optional[Callable[[], Optional[str]]] = None):
         self.address = address
         self.name = name
         host, port = address.rsplit(":", 1)
@@ -440,15 +441,39 @@ class RpcClient:
         self._reader: Optional[threading.Thread] = None
         self._push_handlers: Dict[str, Callable[[Any], None]] = {}
         self._closed = False
+        # HA re-attach (core/ha/reattach.py): a resolver makes this client
+        # survive a head bounce — reconnects consult it for a possibly-
+        # updated address, retryable calls keep redialing for up to
+        # config.ha_reattach_max_s, and reconnect callbacks restore
+        # connection-scoped server state (pubsub subscriptions).
+        self._resolver = resolver
+        self._reconnect_cbs: list = []
+        self._ever_connected = False
 
     # -- connection management --
 
     def connect(self) -> None:
+        is_reconnect = False
         with self._conn_lock:
             if self._sock is not None:
                 return
+            if self._resolver is not None and self._ever_connected:
+                # the head may have come back at a new address
+                try:
+                    new = self._resolver()
+                except Exception:  # noqa: BLE001 — resolver is best-effort
+                    new = None
+                if new and new != self.address:
+                    logger.info(
+                        "%s: target moved %s -> %s",
+                        self.name, self.address, new,
+                    )
+                    self.address = new
+                    host, port = new.rsplit(":", 1)
+                    self._host, self._port = host, int(port)
             deadline = time.monotonic() + config.rpc_connect_timeout_s
             last_err: Optional[Exception] = None
+            connected = False
             from ray_tpu.utils import gateway as gateway_mod
 
             gw = gateway_mod.gateway_address()
@@ -473,13 +498,31 @@ class RpcClient:
                         target=self._read_loop, name=f"{self.name}-read", daemon=True
                     )
                     self._reader.start()
-                    return
+                    is_reconnect = self._ever_connected
+                    self._ever_connected = True
+                    connected = True
+                    break
                 except OSError as e:
                     last_err = e
                     time.sleep(0.05)
-            raise RpcConnectionError(
-                f"cannot connect to {self.address}: {last_err}"
-            )
+            if not connected:
+                raise RpcConnectionError(
+                    f"cannot connect to {self.address}: {last_err}"
+                )
+        if is_reconnect:
+            # outside the conn lock: callbacks typically issue calls on
+            # this client (e.g. re-subscribing pubsub topics)
+            for cb in list(self._reconnect_cbs):
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 — must not break connect
+                    logger.exception("%s: reconnect callback failed", self.name)
+
+    def add_reconnect_callback(self, cb: Callable[[], None]) -> None:
+        """Run cb() after every re-established connection (not the first
+        connect). Used to restore connection-scoped server state — pubsub
+        subscriptions — after a head bounce."""
+        self._reconnect_cbs.append(cb)
 
     def close(self) -> None:
         self._closed = True
@@ -546,8 +589,22 @@ class RpcClient:
     ) -> Any:
         timeout_s = timeout_s if timeout_s is not None else config.rpc_request_timeout_s
         attempts = 1 + (config.rpc_max_retries if retryable else 0)
+        # HA clients (resolver set) ride out a whole head bounce: retryable
+        # calls keep redialing on CONNECTION failures until the re-attach
+        # budget runs out, not just for rpc_max_retries quick attempts.
+        # (Only idempotent calls are marked retryable, so replaying an
+        # in-flight request whose reply was lost in the bounce is safe.)
+        reattach_deadline: Optional[float] = None
+        if retryable and self._resolver is not None:
+            reattach_deadline = time.monotonic() + float(
+                config.ha_reattach_max_s
+            )
         last_err: Optional[Exception] = None
-        for attempt in range(attempts):
+        attempt = 0  # timeout/plain-retry budget (rpc_max_retries)
+        redials = 0  # reattach redials — budgeted by TIME, not count, so
+        # they must not consume the attempt budget: after riding out a
+        # bounce, a slow first answer still gets its full retry allowance
+        while True:
             try:
                 maybe_inject_request_failure(method)
                 result = self._call_once(method, args, kwargs, timeout_s)
@@ -555,8 +612,22 @@ class RpcClient:
                 return result
             except (RpcConnectionError, RpcTimeout) as e:
                 last_err = e
-                if attempt + 1 < attempts and not self._closed:
-                    time.sleep(config.rpc_retry_delay_s * (2**attempt))
+                if self._closed:
+                    raise
+                if (
+                    isinstance(e, RpcConnectionError)
+                    and reattach_deadline is not None
+                ):
+                    if time.monotonic() < reattach_deadline:
+                        redials += 1
+                        time.sleep(
+                            min(config.rpc_retry_delay_s * (2 ** min(redials, 4)), 1.0)
+                        )
+                        continue
+                    raise
+                attempt += 1
+                if attempt < attempts:
+                    time.sleep(config.rpc_retry_delay_s * (2 ** (attempt - 1)))
                     continue
                 raise
             except RemoteError:

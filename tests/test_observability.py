@@ -353,6 +353,35 @@ def test_worker_metrics_aggregate(rt):
     assert agg["obs_work_done"]["series"][()] == 6.0
 
 
+def test_state_tasks_and_objects(rt):
+    """Task/object-level state listings (VERDICT missing #4) built from
+    the task-event rings and the agents' store inventories."""
+    @ray_tpu.remote
+    def emit(i):
+        print(f"state-listing-probe-{i}")
+        return ray_tpu.put(bytes(150_000))  # big enough to land in shm
+
+    inner = ray_tpu.get([emit.remote(i) for i in range(3)])
+    ts = state.tasks()
+    emits = [t for t in ts if t["name"] == "emit"]
+    assert len(emits) >= 3
+    assert all(t["state"] == "FINISHED" for t in emits)
+    assert all(t["dur_us"] is not None and t["worker"] for t in emits)
+    objs = state.objects()
+    stored = [o for o in objs if o["location"] == "store"]
+    assert len(stored) >= 3
+    assert all(o["size"] > 0 and o["node_id"] for o in stored)
+    # the driver holds refs to the inner objects: borrow state surfaces
+    held = [o for o in objs if o["borrows"] or o["inflight_pins"]]
+    assert held, objs
+    # worker stdout is reachable from the driver machine (VERDICT #3)
+    time.sleep(0.3)
+    logs = state.worker_logs()
+    joined = "".join(e["tail"] for e in logs)
+    assert "state-listing-probe-1" in joined
+    del inner
+
+
 def test_cli_smoke(rt, tmp_path, capsys):
     from ray_tpu.cli import main
     from ray_tpu.core import worker as worker_mod
@@ -377,6 +406,13 @@ def test_cli_smoke(rt, tmp_path, capsys):
     assert main(["--address", addr, "--json", "summary"]) == 0
     parsed = json.loads(capsys.readouterr().out)
     assert "tasks" in parsed and "events_dropped" in parsed
+    assert main(["--address", addr, "memory"]) == 0
+    out = capsys.readouterr().out
+    assert "OBJECT_ID" in out or "(none)" in out
+    assert main(["--address", addr, "--json", "memory"]) == 0
+    json.loads(capsys.readouterr().out)
+    assert main(["--address", addr, "logs"]) == 0
+    capsys.readouterr()
 
 
 def test_dashboard_endpoints(rt):

@@ -1,8 +1,41 @@
-"""Control-store persistence test (reference C14: pluggable metadata
-storage — Redis FT mode equivalent, file-backed here)."""
+"""Control-store persistence tests (reference C14: pluggable metadata
+storage — Redis FT mode equivalent; here snapshot + WAL, core/ha/)."""
 
 
-def test_control_store_snapshot_restore(tmp_path):
+ACTOR_ID = "a" * 32
+PG_ID = "b" * 28
+
+
+def _populate(client):
+    client.call("kv_put", ns="fn", key="abc", value=b"blob-1")
+    client.call("kv_put", ns="meta", key="k", value=b"v")
+    client.call("kv_put", ns="meta", key="doomed", value=b"x")
+    client.call("kv_del", ns="meta", key="doomed")
+    # incarnation-scoped collective rendezvous keys must NOT persist
+    client.call("kv_put", ns="coll/g1", key="rank0", value=b"addr")
+    job_id = client.call("register_job", driver_address="d:1", metadata={})
+    client.call(
+        "register_actor",
+        spec={
+            "actor_id": ACTOR_ID,
+            "job_id": job_id,
+            "name": "persistent-actor",
+            "namespace": "default",
+            "class_name": "Dummy",
+            "resources": {"CPU": 1.0},
+            "max_restarts": 0,
+            "lifetime": "detached",
+        },
+    )
+    client.call(
+        "create_placement_group",
+        pg_id=PG_ID, bundles=[{"CPU": 1.0}], strategy="PACK",
+        name="persistent-pg", job_id=job_id,
+    )
+    return job_id
+
+
+def test_control_store_snapshot_restore_all_tables(tmp_path):
     from ray_tpu.core.control_store import ControlStore
     from ray_tpu.utils.rpc import RpcClient
 
@@ -11,22 +44,54 @@ def test_control_store_snapshot_restore(tmp_path):
     cs.start()
     try:
         client = RpcClient(cs.address, name="t")
-        client.call("kv_put", ns="fn", key="abc", value=b"blob-1")
-        client.call("kv_put", ns="meta", key="k", value=b"v")
-        job_id = client.call("register_job", driver_address="d:1", metadata={})
+        job_id = _populate(client)
         client.close()
     finally:
         cs.stop()
 
-    # a NEW control store on the same path restores the metadata
+    # a NEW control store on the same path restores EVERY table
     cs2 = ControlStore("sess2" + "0" * 26, persistence_path=path)
     cs2.start()
     try:
         client = RpcClient(cs2.address, name="t2")
         assert client.call("kv_get", ns="fn", key="abc") == b"blob-1"
         assert client.call("kv_get", ns="meta", key="k") == b"v"
+        assert client.call("kv_get", ns="meta", key="doomed") is None
+        assert client.call("kv_get", ns="coll/g1", key="rank0") is None
         jobs = client.call("list_jobs")
         assert any(j["job_id"] == job_id for j in jobs)
+        # actor record + name registration survive (no node yet: the
+        # restored actor is still awaiting placement, not lost)
+        actors = {a["actor_id"]: a for a in client.call("list_actors")}
+        assert ACTOR_ID in actors
+        assert actors[ACTOR_ID]["name"] == "persistent-actor"
+        assert actors[ACTOR_ID]["state"] != "DEAD"
+        # placement group survives in PENDING (nothing placed it yet)
+        pgs = {p["pg_id"]: p for p in client.call("list_placement_groups")}
+        assert PG_ID in pgs
+        assert pgs[PG_ID]["name"] == "persistent-pg"
+        assert pgs[PG_ID]["state"] == "PENDING"
+        # restored session identity is the ORIGINAL cluster's (agents and
+        # workers key shm/temp paths by it)
+        assert cs2.session_id == "sess1" + "0" * 26
         client.close()
     finally:
         cs2.stop()
+
+
+def test_restore_requires_no_persistence(tmp_path):
+    """A store without a persistence path keeps working with HA off."""
+    from ray_tpu.core.control_store import ControlStore
+    from ray_tpu.utils.rpc import RpcClient
+
+    cs = ControlStore("sess3" + "0" * 26)
+    cs.start()
+    try:
+        client = RpcClient(cs.address, name="t3")
+        client.call("kv_put", ns="x", key="y", value=b"z")
+        st = client.call("ha_status")
+        assert st["enabled"] is False
+        assert st["recovering"] is False
+        client.close()
+    finally:
+        cs.stop()

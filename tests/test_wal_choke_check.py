@@ -1,0 +1,116 @@
+"""WAL-bypass static check (tier-1): the control store's durability
+invariant — every state-table mutation flows through the _apply choke
+point — must hold for the checked-in source, and the checker itself must
+keep catching each bypass pattern."""
+
+import os
+import sys
+import textwrap
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+sys.path.insert(0, TOOLS)
+
+from check_wal_choke import check_file, check_source  # noqa: E402
+
+
+def test_control_store_respects_wal_choke_point():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ray_tpu", "core", "control_store.py",
+    )
+    violations = check_file(path)
+    assert not violations, "\n".join(violations)
+
+
+def _check(body: str):
+    return check_source(textwrap.dedent(body))
+
+
+def test_checker_flags_direct_table_write():
+    violations = _check("""
+        class ControlStore:
+            def rpc_sneaky(self, conn, ns, key, value):
+                self._kv[ns][key] = value
+    """)
+    assert len(violations) == 1 and "rpc_sneaky" in violations[0]
+
+
+def test_checker_flags_mutating_method_call():
+    violations = _check("""
+        class ControlStore:
+            def rpc_sneaky(self, conn, aid):
+                self._actors.pop(aid)
+    """)
+    assert violations and ".pop()" in violations[0]
+
+
+def test_checker_flags_aliased_record_mutation():
+    violations = _check("""
+        class ControlStore:
+            def rpc_sneaky(self, conn, aid):
+                record = self._actors.get(aid)
+                record["state"] = "DEAD"
+    """)
+    assert len(violations) == 1
+
+
+def test_checker_flags_loop_alias_mutation():
+    violations = _check("""
+        class ControlStore:
+            def rpc_sneaky(self, conn):
+                for pg in self._pgs.values():
+                    pg["state"] = "REMOVED"
+    """)
+    assert len(violations) == 1
+
+
+def test_checker_flags_transitive_alias():
+    violations = _check("""
+        class ControlStore:
+            def rpc_sneaky(self, conn):
+                doomed = [a for a in self._actors.values()]
+                for rec in doomed:
+                    rec["state"] = "DEAD"
+    """)
+    assert len(violations) == 1
+
+
+def test_checker_flags_direct_mut_call():
+    violations = _check("""
+        class ControlStore:
+            def rpc_sneaky(self, conn, ns, key, value):
+                self._mut_kv_put(ns, key, value)
+    """)
+    assert violations and "bypasses the WAL choke point" in violations[0]
+
+
+def test_checker_allows_reads_and_mut_functions():
+    violations = _check("""
+        class ControlStore:
+            def _mut_kv_put(self, ns, key, value):
+                self._kv.setdefault(ns, {})[key] = value
+
+            def _apply(self, op, *args):
+                return getattr(self, "_mut_" + op)(*args)
+
+            def rpc_kv_get(self, conn, ns, key):
+                return self._kv.get(ns, {}).get(key)
+
+            def rpc_list(self, conn):
+                return [dict(r) for r in self._actors.values()]
+
+            def rpc_ok(self, conn, ns, key, value):
+                return self._apply("kv_put", ns, key, value)
+    """)
+    assert not violations, violations
+
+
+def test_checker_honors_copy_opt_out():
+    violations = _check("""
+        class ControlStore:
+            def rpc_fine(self, conn, aid):
+                rec = dict(self._actors[aid])
+                rec["state"] = "X"  # wal: copy
+                return rec
+    """)
+    assert not violations, violations
