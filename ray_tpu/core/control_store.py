@@ -37,6 +37,7 @@ from ray_tpu.core import scheduling
 from ray_tpu.core.ha import FileBackend, HAState, write_head_address
 from ray_tpu.observability import core_metrics
 from ray_tpu.utils.config import config
+from ray_tpu.utils import rpc
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu.utils.rpc import ClientPool, RpcError, RpcServer
 
@@ -627,8 +628,14 @@ class ControlStore:
     def publish(self, topic: str, payload: Any) -> None:
         with self._lock:
             conns = list(self._subs.get(topic, {}).values())
+        if not conns:
+            return
+        # serialize ONCE per publish; the encoded frame is shared (read-
+        # only) across every subscriber connection instead of re-pickling
+        # the payload per subscriber
+        bufs = rpc.encode_message(("push", "pubsub", (topic, payload)))
         for c in conns:
-            if not c.push("pubsub", (topic, payload)):
+            if not c.push_encoded(bufs):
                 with self._lock:
                     self._subs.get(topic, {}).pop(id(c), None)
 

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.core import scheduling
 from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.observability import core_metrics
+from ray_tpu.utils import serialization
 from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import NodeID
 from ray_tpu.utils.rpc import RpcClient, RpcError, RpcServer
@@ -82,6 +83,14 @@ class NodeAgent:
         self.control_address = control_address
         self._server = RpcServer("node_agent", host, port)
         self._server.register_instance(self)
+        # raw (in-connection-order) handlers: a worker's oneway seal must
+        # land before the recycle that chases it, and the recycle before
+        # the next create_object, all on the same connection — dispatched
+        # handlers would race and the create would miss the parked pages
+        # every time in a put/delete loop. Both are lock-only (never
+        # block), so inline execution in the read loop is safe.
+        self._server.register_raw("seal_object", self._raw_seal_object)
+        self._server.register_raw("recycle_object", self._raw_recycle_object)
         self._server.on_disconnect = self._owner_conn_closed
 
         from ray_tpu.accelerators import detect_node_resources_and_labels
@@ -1168,10 +1177,32 @@ class NodeAgent:
     def rpc_store_usage(self, conn):
         return self.store.usage()
 
+    def _raw_seal_object(self, conn, req_id, args, kwargs):
+        oid_hex = kwargs.get("oid_hex") or args[0]
+        self.store.seal(oid_hex)
+        RpcServer.reply(conn, req_id, True, True)
+
+    def _raw_recycle_object(self, conn, req_id, args, kwargs):
+        """Owner says: delete this never-shared object, recycling its
+        segment pages into the pool (ShmObjectStore.recycle). Fast path
+        runs inline in the connection read loop (lock-only, no blocking);
+        an entry caught mid-spill/restore falls back to a threaded
+        delete, which waits the move out."""
+        oid_hex = kwargs.get("oid_hex") or args[0]
+        if not self.store.recycle(oid_hex):
+            threading.Thread(
+                target=lambda: self.store.delete(oid_hex),
+                name="agent-recycle-fallback", daemon=True,
+            ).start()
+        RpcServer.reply(conn, req_id, True, True)
+
     def rpc_read_object_chunk(self, conn, path: str, offset: int, length: int):
         """Serve a byte range of a local segment to a cross-node puller
-        (reference C8: push_manager.h chunked transfer)."""
-        return self.store.read_chunk(path, offset, length)
+        (reference C8: push_manager.h chunked transfer). The chunk rides
+        the reply as a raw wire segment (serialization.Frame), not an
+        in-band pickle copy."""
+        chunk = self.store.read_chunk(path, offset, length)
+        return None if chunk is None else serialization.maybe_frame(chunk)
 
     # ------------------------------------------------------------------
     # introspection (state API backing)

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.exceptions import ObjectLostError
 from ray_tpu.observability import core_metrics
+from ray_tpu.utils import serialization
 from ray_tpu.utils.ids import ObjectID
 
 _SHM_DIR = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
@@ -73,6 +74,22 @@ class ShmObjectStore:
         self._prefix = os.path.join(
             _SHM_DIR, f"rtshm_{session_id[:8]}_{node_id_hex[:8]}"
         )
+        # Segment recycle pool (plasma-arena equivalent): freeing a tmpfs
+        # file returns its pages to the kernel, so every create pays page
+        # allocation + zeroing again (~3x the write cost at 4 MiB).
+        # Plasma dodges this by malloc'ing objects out of ONE preallocated
+        # shm arena; here, deleted never-shared segments park in a rename
+        # pool and the next create renames one back into place — pages
+        # stay warm. Only the owner's private segments are eligible
+        # (worker.delete_owned_object), so no other process can hold a
+        # mapping whose bytes would change under it.
+        self._recycle_prefix = os.path.join(
+            _SHM_DIR, f"rtpool_{session_id[:8]}_{node_id_hex[:8]}"
+        )
+        self._recycle: List[Tuple[int, str]] = []  # (size, path)
+        self._recycle_bytes = 0
+        self._recycle_seq = 0
+        self._recycle_cap = min(256 * 1024 * 1024, capacity_bytes // 4)
         # For validating peer-supplied paths: resolve symlinks once so the
         # comparison works even when the shm dir itself is a symlink.
         self._real_dir = os.path.realpath(_SHM_DIR)
@@ -151,10 +168,22 @@ class ShmObjectStore:
             self._sealed_cv.notify_all()
 
     def _ensure_room_locked(self, size: int) -> None:
-        """Make room for `size` bytes, spilling LRU victims. Called with
-        the lock held; TEMPORARILY RELEASES it for the byte copies."""
+        """Make room for `size` bytes: drain the recycle pool first (its
+        pages are free the moment the file unlinks), then spill LRU
+        victims. Called with the lock held; TEMPORARILY RELEASES it for
+        the byte copies."""
         while True:
             # account bytes still being spilled by other threads as free-soon
+            while (
+                self._recycle
+                and self._used + self._recycle_bytes + size > self._capacity
+            ):
+                rsize, rpath = self._recycle.pop()
+                self._recycle_bytes -= rsize
+                try:
+                    os.unlink(rpath)
+                except OSError:
+                    pass
             if self._used + size <= self._capacity:
                 return
             need = self._used + size - self._capacity
@@ -253,6 +282,7 @@ class ShmObjectStore:
                 self._objects.pop(oid_hex, None)
                 self._used -= size
                 raise
+            recycled = self._pop_recycle_locked(size)
             if core_metrics.ENABLED:
                 self._publish_gauges_locked()
         for p in drop_paths:
@@ -260,12 +290,78 @@ class ShmObjectStore:
                 os.unlink(p)
             except OSError:
                 pass
+        if recycled is not None:
+            # reuse a parked segment's warm pages: rename into place and
+            # trim/grow to the exact size (ftruncate frees any excess)
+            try:
+                os.rename(recycled[1], path)
+                fd = os.open(path, os.O_RDWR)
+                try:
+                    os.ftruncate(fd, max(size, 1))
+                finally:
+                    os.close(fd)
+                return path
+            except OSError:
+                pass  # pool file vanished: fall through to a fresh create
         fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, max(size, 1))
         finally:
             os.close(fd)
         return path
+
+    def _pop_recycle_locked(self, size: int):
+        """Best-fit pooled segment for a create of ``size`` bytes: the
+        smallest parked file that already covers it (shrink = free pages),
+        else the largest (grow = only the tail pages are cold)."""
+        if not self._recycle:
+            return None
+        best = None
+        for i, (rsize, _) in enumerate(self._recycle):
+            if rsize >= size:
+                if best is None or rsize < self._recycle[best][0]:
+                    best = i
+        if best is None:
+            best = max(
+                range(len(self._recycle)), key=lambda i: self._recycle[i][0]
+            )
+        entry = self._recycle.pop(best)
+        self._recycle_bytes -= entry[0]
+        return entry
+
+    def recycle(self, oid_hex: str) -> bool:
+        """Delete an object, parking its segment file in the recycle pool
+        for the next create (warm pages). Only callable for never-shared
+        segments — the owner guarantees no other process maps the file.
+        Returns False when the entry is mid-spill/restore or not plain
+        sealed shm; the caller falls back to a normal delete()."""
+        with self._lock:
+            entry = self._objects.get(oid_hex)
+            if entry is None:
+                return True
+            if not entry.sealed or entry.state != "shm":
+                return False
+            self._objects.pop(oid_hex)
+            self._used -= entry.size
+            park = self._recycle_bytes + entry.size <= self._recycle_cap
+            if park:
+                self._recycle_seq += 1
+                pool_path = f"{self._recycle_prefix}_{self._recycle_seq}"
+                try:
+                    os.rename(entry.path, pool_path)
+                except OSError:
+                    park = False
+                else:
+                    self._recycle.append((entry.size, pool_path))
+                    self._recycle_bytes += entry.size
+            if core_metrics.ENABLED:
+                self._publish_gauges_locked()
+        if not park:
+            try:
+                os.unlink(entry.path)
+            except OSError:
+                pass
+        return True
 
     def seal(self, oid_hex: str) -> None:
         with self._lock:
@@ -337,7 +433,12 @@ class ShmObjectStore:
         ObjectLostError, same as a vanished segment. Spilled objects serve
         straight from the spill file without restoring; an in-flight
         spill/restore is waited out (reading a path that is about to be
-        unlinked would misreport a live object as lost)."""
+        unlinked would misreport a live object as lost). An UNSEALED entry
+        is likewise waited out (bounded): writers seal with a oneway call,
+        so a reader who learned the path from the owner's already-stored
+        marker can race the seal frame across connections — the seal is
+        microseconds behind, and only a dead producer leaves an entry
+        unsealed for long."""
         real = os.path.realpath(path)
         base = os.path.basename(real)
         marker = self._base_prefix + "_"
@@ -345,8 +446,12 @@ class ShmObjectStore:
             raise ValueError(f"path {path} is not an object in this store")
         oid_hex = base[len(marker):]
         with self._lock:
+            deadline = time.monotonic() + 10.0  # in-flight seal bound
             entry = self._objects.get(oid_hex)
-            while entry is not None and entry.state in ("spilling", "restoring"):
+            while entry is not None and (
+                entry.state in ("spilling", "restoring")
+                or (not entry.sealed and time.monotonic() < deadline)
+            ):
                 self._sealed_cv.wait(1.0)
                 entry = self._objects.get(oid_hex)
             if entry is None or not entry.sealed:
@@ -430,6 +535,9 @@ class ShmObjectStore:
             self._objects.clear()
             self._used = 0
             self._spilled_bytes = 0
+            pool = self._recycle
+            self._recycle = []
+            self._recycle_bytes = 0
         for e in entries:
             for p in (e.path, e.spill_path):
                 if p:
@@ -437,6 +545,11 @@ class ShmObjectStore:
                         os.unlink(p)
                     except OSError:
                         pass
+        for _, p in pool:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 def _pwrite_all(fd: int, data, offset: int) -> None:
@@ -448,6 +561,30 @@ def _pwrite_all(fd: int, data, offset: int) -> None:
         n = os.pwrite(fd, view, offset)
         view = view[n:]
         offset += n
+
+
+_IOV_CAP = 512  # stay under IOV_MAX (1024)
+
+
+def pwritev_all(fd: int, parts, offset: int = 0) -> None:
+    """Vectored pwrite of every buffer, resuming across short writes and
+    the per-call IOV/2 GiB caps. The write-through put path: header, meta
+    and pickle-5 buffers land in the segment with ONE kernel copy, no
+    userspace concatenation (vs pack() + pwrite = two full copies)."""
+    if not hasattr(os, "pwritev"):  # pragma: no cover — macOS/Windows
+        for p in parts:
+            v = memoryview(p).cast("B")
+            _pwrite_all(fd, v, offset)
+            offset += v.nbytes
+        return
+    views = serialization.byte_views(parts)
+    i = 0
+    while i < len(views):
+        n = os.pwritev(fd, views[i:i + _IOV_CAP], offset)
+        if n <= 0:
+            raise OSError("pwritev made no progress")
+        offset += n
+        i = serialization.advance_views(views, i, n)
 
 
 class ShmClient:
@@ -489,6 +626,23 @@ class ShmClient:
             except (BufferError, ValueError):
                 # Live numpy views still reference the mapping; leave it to GC.
                 pass
+
+    def try_drop(self, path: str) -> bool:
+        """Close the cached mapping for ``path`` IF nothing references it.
+        True when the mapping is gone (closed now, or never existed);
+        False when live views (e.g. numpy arrays a get() returned) still
+        pin it — the caller must then treat the segment as shared and not
+        recycle its pages."""
+        with self._lock:
+            m = self._maps.get(path)
+            if m is None:
+                return True
+            try:
+                m.close()
+            except (BufferError, ValueError):
+                return False
+            del self._maps[path]
+            return True
 
     def close(self) -> None:
         with self._lock:
@@ -606,14 +760,23 @@ class PlasmaValue:
     Carries the hosting node agent's address so any process can free the
     segment; same-host readers mmap the path directly, cross-host readers
     pull chunks through the hosting agent (worker.py _pull_remote_object /
-    node_agent rpc_read_object_chunk)."""
+    node_agent rpc_read_object_chunk).
 
-    __slots__ = ("path", "size", "agent_address")
+    ``private`` is True only for segments this owner created locally
+    (write-through put) whose path was never handed to another process;
+    the first get_object reply that exposes the path clears it. Private
+    segments are eligible for page recycling on delete
+    (ShmObjectStore.recycle) — shared ones never are, because a reader's
+    mapping must keep its bytes forever."""
 
-    def __init__(self, path: str, size: int, agent_address: str):
+    __slots__ = ("path", "size", "agent_address", "private")
+
+    def __init__(self, path: str, size: int, agent_address: str,
+                 private: bool = False):
         self.path = path
         self.size = size
         self.agent_address = agent_address
+        self.private = private
 
 
 class LostValue:

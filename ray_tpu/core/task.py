@@ -115,7 +115,9 @@ class TaskSpec:
     task_id: Any  # TaskID
     fn_id: str
     fn_name: str
-    args_frame: bytes  # packed (args, kwargs) — ObjectRefs travel as refs
+    # packed (args, kwargs) as a serialization.Frame (rides RPC as a raw
+    # trailing wire segment) — ObjectRefs travel as refs
+    args_frame: Any
     num_returns: int
     owner_address: str
     resources: Dict[str, float]

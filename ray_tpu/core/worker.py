@@ -49,6 +49,7 @@ from ray_tpu.core.object_store import (
     PlasmaValue,
     ShmClient,
     _pwrite_all,
+    pwritev_all,
 )
 from ray_tpu.core.task import TaskOptions, TaskSpec
 from ray_tpu.observability import core_metrics, tracing
@@ -406,6 +407,15 @@ class CoreWorker:
 
         self.memory_store = MemoryStore()
         self.shm = ShmClient()
+        # deferred segment reclaim: private segments whose DELETE arrived
+        # while live views (arrays a get() returned) still pinned the
+        # mapping — in `get(put(x))` the value dies a beat AFTER the ref,
+        # so recycling at ref-death would always miss. Entries are
+        # [oid_hex, path, attempts]; flushed before the next plasma
+        # create (the previous iteration's views are dead by then), so a
+        # put/delete loop reuses its own warm pages.
+        self._pending_reclaim: deque = deque()
+        self._pending_reclaim_lock = threading.Lock()
         # data-plane port cache per agent: addr -> (port, fetched_at);
         # entries expire so an agent restart gets re-discovered
         self._data_ports: Dict[str, Tuple[int, float]] = {}
@@ -672,9 +682,118 @@ class CoreWorker:
                 )
                 return ObjectRef(oid, self.address)
             # no device arrays inside: fall through to the object path
-        frame = serialization.pack(value)
-        self._store_frame_maybe_plasma(oid, frame)
+        self.memory_store.put(oid, self._serialize_to_store(oid, value))
         return ObjectRef(oid, self.address)
+
+    def _serialize_to_store(self, oid: ObjectID, value: Any):
+        """Serialize a value to its stored form: a PlasmaValue whose frame
+        was written through to shm (write-through put: the pickle-5
+        buffers are sized first, the segment created at exactly that
+        size, then header+meta+buffers land in ONE vectored pwritev — no
+        intermediate pack() concatenation, no second shm copy), or an
+        in-band frame below the plasma threshold."""
+        meta, views = serialization.serialize(value)
+        total = serialization.frame_nbytes(meta, views)
+        if self._remote_driver or total <= config.max_direct_call_object_size:
+            # no local shm on a gateway driver: keep the frame owner-side;
+            # consumers fetch via get_object (chunked over the tunnel)
+            return serialization.pack_parts(meta, views)
+        path = self._write_through_plasma(oid.hex(), meta, views, total)
+        return PlasmaValue(path, total, self.node_agent_address, private=True)
+
+    _RECLAIM_MAX = 32
+    _RECLAIM_ATTEMPTS = 8
+
+    def _flush_pending_reclaim(self) -> None:
+        """Retry deferred reclaims: a segment whose views have died since
+        its delete gets recycled (warm pages for the create about to
+        happen on the same connection); one whose views persist re-queues
+        up to _RECLAIM_ATTEMPTS, then downgrades to a plain delete (the
+        pinned mapping keeps its pages either way — the downgrade only
+        restores the agent's accounting)."""
+        if not self._pending_reclaim:
+            return
+        with self._pending_reclaim_lock:
+            pending = list(self._pending_reclaim)
+            self._pending_reclaim.clear()
+        for entry in pending:
+            oid_hex, path, attempts = entry
+            try:
+                if self.shm.try_drop(path):
+                    self.agent.call_oneway("recycle_object", oid_hex=oid_hex)
+                elif attempts + 1 >= self._RECLAIM_ATTEMPTS:
+                    # evict the cached mapping too (GC closes it when the
+                    # views die) — a cache entry surviving the unlink
+                    # would pin the dead pages for the process lifetime
+                    self.shm.drop(path)
+                    self.agent.call_oneway(
+                        "delete_objects", oid_hexes=[oid_hex]
+                    )
+                else:
+                    with self._pending_reclaim_lock:
+                        self._pending_reclaim.append(
+                            [oid_hex, path, attempts + 1]
+                        )
+            except RpcError:
+                pass
+
+    def _defer_reclaim(self, oid: ObjectID, path: str) -> None:
+        overflow = None
+        with self._pending_reclaim_lock:
+            self._pending_reclaim.append([oid.hex(), path, 0])
+            if len(self._pending_reclaim) > self._RECLAIM_MAX:
+                overflow = self._pending_reclaim.popleft()
+        if overflow is not None:
+            self.shm.drop(overflow[1])  # evict cache; GC closes with the views
+            try:
+                self.agent.call_oneway(
+                    "delete_objects", oid_hexes=[overflow[0]]
+                )
+            except RpcError:
+                pass
+
+    def _write_through_plasma(
+        self, oid_hex: str, meta, views, total: int
+    ) -> str:
+        """create_object at the exact frame size, then pwritev the
+        scatter-gather pieces straight into the segment. seal rides a
+        oneway call: same-host readers only learn the path from the
+        marker we store after this returns, and get_meta-based readers
+        block on the store's sealed condition, so ordering is safe."""
+        self._flush_pending_reclaim()
+        path = self.agent.call("create_object", oid_hex=oid_hex, size=total)
+        parts = serialization.frame_parts(meta, views)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            pwritev_all(fd, parts)
+        finally:
+            os.close(fd)
+        if serialization.copy_hook is not None:
+            serialization.note_copy(total, "put-pwritev")
+        self._send_seal(oid_hex)
+        return path
+
+    def _send_seal(self, oid_hex: str) -> None:
+        """Seal without waiting, but with delivery guaranteed: the frame
+        goes out synchronously (in-order with the surrounding create /
+        recycle traffic on this connection — the agent's raw handler
+        preserves that order), and the ack is checked asynchronously — a
+        seal lost to a dropped connection is re-sent with the full retry
+        ladder, because an unsealed segment wedges every future reader
+        of an object whose put() already reported success."""
+        pending = self.agent.call_async("seal_object", oid_hex=oid_hex)
+
+        def _on_done(p, oid_hex=oid_hex):
+            if not p.ok:
+                self._submit_pool.submit(self._retry_seal, oid_hex)
+
+        pending.add_done_callback(_on_done)
+
+    def _retry_seal(self, oid_hex: str) -> None:
+        try:
+            self.agent.call("seal_object", oid_hex=oid_hex, retryable=True)
+        except RpcError:
+            pass  # object deleted meanwhile, or agent truly gone
 
     @property
     def device_store(self):
@@ -744,21 +863,27 @@ class CoreWorker:
         arrays = jax.device_put(hosts)
         return dev_mod.join_device_value(dv.skeleton, arrays)
 
-    def _store_frame_maybe_plasma(self, oid: ObjectID, frame: bytes) -> None:
-        if self._remote_driver:
+    def _store_frame_maybe_plasma(self, oid: ObjectID, frame) -> None:
+        """Store an ALREADY-PACKED frame (placement specs, channel relays):
+        write-through to shm above the plasma threshold, in-band below."""
+        nbytes = len(frame)
+        if self._remote_driver or nbytes <= config.max_direct_call_object_size:
             # no local shm on a gateway driver: keep the frame owner-side;
             # consumers fetch via get_object (chunked over the tunnel)
             self.memory_store.put(oid, frame)
             return
-        if len(frame) > config.max_direct_call_object_size:
-            path = self.agent.call("create_object", oid_hex=oid.hex(), size=len(frame))
-            self.shm.write(path, frame)
-            self.agent.call("seal_object", oid_hex=oid.hex())
-            self.memory_store.put(
-                oid, PlasmaValue(path, len(frame), self.node_agent_address)
-            )
-        else:
-            self.memory_store.put(oid, frame)
+        path = self.agent.call("create_object", oid_hex=oid.hex(), size=nbytes)
+        fd = os.open(path, os.O_RDWR)
+        try:
+            pwritev_all(fd, [serialization.as_view(frame)])
+        finally:
+            os.close(fd)
+        if serialization.copy_hook is not None:
+            serialization.note_copy(nbytes, "put-pwritev")
+        self._send_seal(oid.hex())
+        self.memory_store.put(
+            oid, PlasmaValue(path, nbytes, self.node_agent_address)
+        )
 
     def get(self, refs: List[ObjectRef], timeout_s: Optional[float] = None) -> List[Any]:
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
@@ -832,7 +957,7 @@ class CoreWorker:
                     raise
 
     def _materialize(self, stored: Any) -> Any:
-        if isinstance(stored, (bytes, bytearray, memoryview)):
+        if serialization.is_bytes_like(stored):
             return serialization.unpack(stored)
         if isinstance(stored, PlasmaValue):
             if (
@@ -953,14 +1078,17 @@ class CoreWorker:
             del inflight[off]
             piece = pending.wait(60.0)
             expected = min(chunk, size - off)
-            if not piece or len(piece) != expected:
+            mv = serialization.as_view(piece) if piece is not None else None
+            if mv is None or mv.nbytes != expected:
                 # None (file gone) or short (segment truncated/replaced):
                 # either way the object is lost. A gap must never be
                 # silently zero-filled.
                 raise ObjectLostError(
                     f"remote segment {path} vanished during transfer"
                 )
-            buf[off:off + len(piece)] = piece
+            buf[off:off + mv.nbytes] = mv
+            if serialization.copy_hook is not None:
+                serialization.note_copy(mv.nbytes, "pull-chunk-assemble")
             done += 1
         return memoryview(buf)  # no copy; unpack accepts buffer views
 
@@ -1190,14 +1318,48 @@ class CoreWorker:
                     pass
 
     def delete_owned_object(self, oid: ObjectID) -> None:
+        # ref GC runs steadily even when large puts stop, so deferred
+        # reclaims can't sit pinned for the worker's lifetime
+        self._flush_pending_reclaim()
         stored = self.memory_store.try_get(oid)
         self.memory_store.delete(oid)
         self._drop_lineage_return(oid)
         if isinstance(stored, PlasmaValue):
+            # Drop our cached mapping while the file still exists — a
+            # mapping pinned past the unlink holds the (dead) pages for
+            # the life of the process. try_drop refuses when live views
+            # (arrays a get() returned) still reference it.
+            local = (
+                stored.agent_address == self.node_agent_address
+                and not self._remote_driver
+            )
+            released = self.shm.try_drop(stored.path) if local else True
             try:
-                self.agents.get(stored.agent_address).call_oneway(
-                    "delete_objects", oid_hexes=[oid.hex()]
-                )
+                if stored.private and local:
+                    if released:
+                        # never shared + no live local views: the
+                        # segment's pages can be recycled into the next
+                        # create. Rides self.agent — the SAME connection
+                        # create_object uses — so the raw in-order
+                        # handler parks the pages before our next create
+                        # asks for them.
+                        self.agent.call_oneway(
+                            "recycle_object", oid_hex=oid.hex()
+                        )
+                    else:
+                        # views still pin the mapping (the usual case in
+                        # `get(put(x))`: the value outlives the ref by a
+                        # beat) — defer; the next plasma put retries
+                        self._defer_reclaim(oid, stored.path)
+                else:
+                    if local and not released:
+                        # shared segment with live views: evict the cache
+                        # entry now (GC closes it with the views) so the
+                        # unlinked pages don't stay pinned forever
+                        self.shm.drop(stored.path)
+                    self.agents.get(stored.agent_address).call_oneway(
+                        "delete_objects", oid_hexes=[oid.hex()]
+                    )
             except RpcError:
                 pass
         elif isinstance(stored, DeviceValue):
@@ -1257,7 +1419,9 @@ class CoreWorker:
                     tr.add_task_borrow(oid)
                 else:
                     self.send_add_borrow(addr, oid)
-        return frame
+        # big args frames ride push_task as a raw trailing wire segment
+        # instead of being re-pickled in-band per hop
+        return serialization.maybe_frame(frame)
 
     def _release_arg_pins(self, task_hex: str) -> None:
         """Task reached a terminal state: drop its args' pendency borrows."""
@@ -2374,18 +2538,18 @@ class CoreWorker:
                     ))
                     continue
                 # no device arrays in the value: ordinary object path
-            frame = serialization.pack(value)
-            if len(frame) > config.max_direct_call_object_size:
-                path = self.agent.call(
-                    "create_object", oid_hex=oid.hex(), size=len(frame)
-                )
-                self.shm.write(path, frame)
-                self.agent.call("seal_object", oid_hex=oid.hex())
+            meta, views = serialization.serialize(value)
+            total = serialization.frame_nbytes(meta, views)
+            if total > config.max_direct_call_object_size:
+                path = self._write_through_plasma(oid.hex(), meta, views, total)
                 returns.append(
-                    (oid.hex(), ("plasma", (path, len(frame), self.node_agent_address)))
+                    (oid.hex(), ("plasma", (path, total, self.node_agent_address)))
                 )
             else:
-                returns.append((oid.hex(), ("frame", frame)))
+                # big frames ride the reply as a raw trailing wire segment
+                # (multi-segment RPC) instead of an in-band re-pickle
+                returns.append((oid.hex(), ("frame", serialization.maybe_frame(
+                    serialization.pack_parts(meta, views)))))
         return returns
 
     def _stream_returns(self, spec: TaskSpec, result: Any) -> List[Tuple[str, Any]]:
@@ -2399,16 +2563,14 @@ class CoreWorker:
         count = 0
         for value in result:
             oid = ObjectID.from_task(spec.task_id, count)
-            frame = serialization.pack(value)
-            if len(frame) > config.max_direct_call_object_size:
-                path = self.agent.call(
-                    "create_object", oid_hex=oid.hex(), size=len(frame)
-                )
-                self.shm.write(path, frame)
-                self.agent.call("seal_object", oid_hex=oid.hex())
-                payload = ("plasma", (path, len(frame), self.node_agent_address))
+            meta, views = serialization.serialize(value)
+            total = serialization.frame_nbytes(meta, views)
+            if total > config.max_direct_call_object_size:
+                path = self._write_through_plasma(oid.hex(), meta, views, total)
+                payload = ("plasma", (path, total, self.node_agent_address))
             else:
-                payload = ("frame", frame)
+                payload = ("frame", serialization.maybe_frame(
+                    serialization.pack_parts(meta, views)))
             owner.call_oneway(
                 "stream_item", task_id_hex=spec.task_id.hex(),
                 index=count, payload=payload,
@@ -2431,9 +2593,26 @@ class CoreWorker:
             stored = self.memory_store.get(oid, wait_s)
         except TimeoutError:
             return ("error", GetTimeoutError(f"object {oid_hex} not ready"))
-        if isinstance(stored, (bytes, bytearray)):
+        if serialization.is_bytes_like(stored):
+            # big frames ride the reply as a raw wire segment — never
+            # re-pickled in-band
+            if not isinstance(stored, serialization.Frame):
+                stored = serialization.maybe_frame(stored)
             return ("frame", stored)
         if isinstance(stored, PlasmaValue):
+            # the path escapes to another process: the segment is shared
+            # from here on and must never be page-recycled. Clear the
+            # bit FIRST, then re-check liveness: delete_owned_object
+            # removes the marker from the store BEFORE it reads
+            # `private`, so either our re-check sees the deletion (reply
+            # error, no path escapes) or the deleter sees private=False
+            # (no recycle) — a concurrently-deleted segment can never be
+            # both handed out and page-recycled.
+            stored.private = False
+            if os_mod.is_missing(self.memory_store.try_get(oid)):
+                return ("error", ObjectLostError(
+                    f"object {oid_hex} was freed during get"
+                ))
             if (
                 requester_agent is not None
                 and requester_agent != stored.agent_address

@@ -238,7 +238,9 @@ def _unfold(x, B, H):  # [B*H, T, D] -> [B, T, H, D]
 
 
 def _params():
-    return pltpu.CompilerParams(
+    from ray_tpu.ops.jax_compat import pallas_tpu_compiler_params_cls
+
+    return pallas_tpu_compiler_params_cls()(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
 

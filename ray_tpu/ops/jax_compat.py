@@ -1,0 +1,48 @@
+"""Version-compat shims for jax APIs the ops kernels ride on.
+
+The kernels target the current jax surface (``jax.shard_map`` with
+``check_vma``, ``pallas.tpu.CompilerParams``); older jax releases spell
+the same things ``jax.experimental.shard_map`` / ``check_rep`` /
+``TPUCompilerParams``. These shims resolve the spelling ONCE at import
+so the kernels stay version-agnostic instead of breaking on every jax
+API rename (the "11 seed failures from jax API drift" class of bug).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+
+@functools.lru_cache(maxsize=1)
+def _shard_map_fn_and_kwarg():
+    try:
+        from jax import shard_map as sm  # jax >= 0.8 export
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map as sm
+    params = inspect.signature(sm).parameters
+    if "check_vma" in params:
+        return sm, "check_vma"
+    if "check_rep" in params:  # the pre-0.6 spelling of the same knob
+        return sm, "check_rep"
+    return sm, None
+
+
+def shard_map_unchecked(fn, *, mesh, in_specs, out_specs):
+    """shard_map with replication/VMA checking off (our kernels use
+    collectives whose replication the checker cannot prove), under
+    whichever keyword this jax spells it."""
+    sm, kwarg = _shard_map_fn_and_kwarg()
+    kwargs = {kwarg: False} if kwarg else {}
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_tpu_compiler_params_cls():
+    """pallas.tpu.CompilerParams (new name) / TPUCompilerParams (old)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # pragma: no cover — older jax
+        cls = pltpu.TPUCompilerParams
+    return cls

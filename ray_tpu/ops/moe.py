@@ -128,15 +128,12 @@ def moe_block_sharded(
     """shard_map wrapper: batch over ep (tokens sharded), experts over ep."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map as _shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _shard_map
+    from ray_tpu.ops.jax_compat import shard_map_unchecked
 
     fn = functools.partial(
         moe_block, capacity=capacity, axis_name=ep_axis, top_k=top_k
     )
-    return _shard_map(
+    return shard_map_unchecked(
         fn,
         mesh=mesh,
         in_specs=(
@@ -146,5 +143,4 @@ def moe_block_sharded(
             P(ep_axis, None, None),
         ),
         out_specs=P(ep_axis, None),
-        check_vma=False,
     )(x, wg, w_in, w_out)

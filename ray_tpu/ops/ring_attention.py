@@ -258,16 +258,12 @@ def ring_attention_sharded(
     """shard_map wrapper: T over cp, batch over data axes, heads over tp."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map as _shard_map  # jax >= 0.8 export
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map as _shard_map
+    from ray_tpu.ops.jax_compat import shard_map_unchecked
 
     batch = tuple(a for a in batch_axes if a in mesh.shape)
     spec = P(batch if batch else None, cp_axis, head_axis, None)
     impl = ring_attention if block_impl == "flash" else ring_attention_einsum
     fn = functools.partial(impl, axis_name=cp_axis, causal=causal)
-    return _shard_map(
+    return shard_map_unchecked(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )(q, k, v)

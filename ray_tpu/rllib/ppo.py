@@ -187,8 +187,18 @@ class PPO:
             jax.random.PRNGKey(cfg.seed), probe.observation_size,
             probe.num_actions, cfg.hidden,
         )
-        self.opt = optax.adam(cfg.lr)
+        # global-norm gradient clipping ahead of adam: the stock PPO
+        # stabilizer against late-training policy collapse (reference
+        # rllib default grad_clip on the same loss family)
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(0.5), optax.adam(cfg.lr)
+        )
         self.opt_state = self.opt.init(self.params)
+        # best-iterate checkpoint (by rollout return): greedy evaluation
+        # serves the best policy seen, not whatever the last SGD epoch
+        # left behind — the in-memory analogue of keep-best checkpointing
+        self.best_params = None
+        self.best_return = -float("inf")
         self.runners = [
             EnvRunner.remote(cfg.env, cfg.seed * 1000 + i)
             for i in range(cfg.num_env_runners)
@@ -273,10 +283,19 @@ class PPO:
         episode_returns = [
             r for b in batches for r in b["episode_returns"]
         ]
+        return_mean = (
+            float(np.mean(episode_returns)) if episode_returns else None
+        )
+        if return_mean is not None and return_mean > self.best_return:
+            # snapshot the params that PRODUCED these rollouts (pre-update
+            # for this iteration's SGD — the policy the returns measure)
+            self.best_return = return_mean
+            self.best_params = params_np
         return {
             "training_iteration": self.iteration,
-            "episode_return_mean": (
-                float(np.mean(episode_returns)) if episode_returns else None
+            "episode_return_mean": return_mean,
+            "best_return": (
+                self.best_return if self.best_params is not None else None
             ),
             "num_episodes": len(episode_returns),
             "loss": float(np.mean(losses)),
@@ -286,8 +305,14 @@ class PPO:
     def get_policy_params(self):
         return self.params
 
-    def compute_action(self, obs: np.ndarray) -> int:
-        params_np = {k: np.asarray(v) for k, v in self.params.items()}
+    def compute_action(self, obs: np.ndarray, use_best: bool = True) -> int:
+        """Greedy action. With use_best (default) the best-return iterate
+        serves the action — deploy-the-best-checkpoint semantics;
+        use_best=False evaluates the live (latest) params."""
+        params = self.params
+        if use_best and self.best_params is not None:
+            params = self.best_params
+        params_np = {k: np.asarray(v) for k, v in params.items()}
         logits, _ = _forward_np(params_np, np.asarray(obs, np.float32))
         return int(np.argmax(logits))
 

@@ -93,6 +93,11 @@ config.define("rpc_connect_timeout_s", 10.0)
 config.define("rpc_request_timeout_s", 60.0)
 config.define("rpc_max_retries", 3)
 config.define("rpc_retry_delay_s", 0.1)
+# Multi-segment scatter-gather frames for data-bearing RPC messages
+# (utils/rpc.py). Off = every frame is legacy single-segment (in-band
+# payload pickling): the one-release compat escape hatch for clusters
+# mixing pre-multiseg readers with new writers.
+config.define("rpc_multiseg", True)
 # Fault injection: "Service.Method:p_request:p_response" comma list
 # (mirror of RAY_testing_rpc_failure, src/ray/common/ray_config_def.h:862).
 config.define("testing_rpc_failure", "")

@@ -9,16 +9,45 @@ object store.
 Wire format of a serialized object:
   meta:    pickled bytes (with PickleBuffer placeholders)
   buffers: list of raw buffers, referenced in order by the meta stream
+
+The object-frame layout (pack/frame_parts) and the RPC multi-segment frame
+(utils/rpc.py) both ride on serialize(): the meta stream travels in-band,
+every out-of-band buffer travels as a raw segment. Frame wraps an
+already-packed byte frame so it, too, rides out-of-band instead of being
+re-pickled (memcpy'd) inside an RPC message.
 """
 
 from __future__ import annotations
 
 import pickle
-from typing import Any, List, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
 PROTOCOL = 5
+
+# ---------------------------------------------------------------------------
+# Copy accounting (test hook)
+# ---------------------------------------------------------------------------
+
+# When set, every host-side bulk copy (>= COPY_HOOK_MIN_NBYTES) on the
+# object data path reports here as hook(nbytes, site). Tests assert e.g.
+# that a same-host put->get roundtrip of a 4 MiB array does at most ONE
+# host copy (the write into shm). Off by default: call sites guard on
+# `copy_hook is not None`, one predicted-false branch on the hot path.
+copy_hook: Optional[Callable[[int, str], None]] = None
+COPY_HOOK_MIN_NBYTES = 1 << 18
+
+
+def note_copy(nbytes: int, site: str) -> None:
+    hook = copy_hook
+    if hook is not None and nbytes >= COPY_HOOK_MIN_NBYTES:
+        hook(nbytes, site)
+
+
+# ---------------------------------------------------------------------------
+# Core pickle-5 split serialization
+# ---------------------------------------------------------------------------
 
 
 def serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
@@ -46,8 +75,111 @@ def dumps(obj: Any) -> bytes:
         return cloudpickle.dumps(obj, protocol=PROTOCOL)
 
 
-def loads(data: bytes) -> Any:
+def loads(data) -> Any:
     return pickle.loads(data)
+
+
+class Frame:
+    """Zero-copy container for an already-serialized byte frame.
+
+    RPC messages carry packed object frames (pack() output) in their
+    payloads; a bare ``bytes`` field would be re-pickled — i.e. memcpy'd
+    — in-band. Frame pickles its payload as a PickleBuffer, so under the
+    multi-segment wire format (utils/rpc.py) the bytes ride as a raw
+    trailing segment: written with vectored sendmsg on one side, received
+    with recv_into on the other, never re-pickled. Under plain dumps()
+    (legacy peers, the WAL, snapshots) it degrades to an in-band copy and
+    reconstructs as Frame(bytes) — both directions stay readable across
+    mixed-version clusters.
+    """
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data  # bytes | bytearray | memoryview
+
+    @property
+    def nbytes(self) -> int:
+        return memoryview(self.data).nbytes
+
+    def __len__(self) -> int:
+        return self.nbytes
+
+    def view(self) -> memoryview:
+        return memoryview(self.data)
+
+    def __reduce_ex__(self, protocol):
+        if protocol >= 5:
+            return (Frame, (pickle.PickleBuffer(self.data),))
+        return (Frame, (bytes(self.data),))
+
+    def __repr__(self):
+        return f"<Frame {self.nbytes}B>"
+
+
+# Below this size a frame stays in-band: a multi-segment wire frame
+# costs the receiver ~3 extra recv(2) calls, which beats a memcpy only
+# once the payload dwarfs the syscalls.
+FRAME_OOB_MIN = 32 * 1024
+
+
+def maybe_frame(data):
+    """Wrap a packed frame for out-of-band transport when it is big
+    enough for zero-copy to win; small frames ride in-band. Honors the
+    rpc_multiseg kill switch: a Frame pickles as a global reference to
+    this class, which a pre-multiseg peer cannot resolve — with the
+    switch off (the mixed-version compat mode) payloads must stay plain
+    bytes end to end, not just in-band."""
+    from ray_tpu.utils.config import config
+
+    if len(data) >= FRAME_OOB_MIN and config.rpc_multiseg:
+        return Frame(data)
+    return data
+
+
+def as_view(data) -> memoryview:
+    """Uniform zero-copy view over Frame / bytes / bytearray / memoryview /
+    PickleBuffer (what a Frame reconstructs from under buffers=)."""
+    if isinstance(data, Frame):
+        data = data.data
+    if isinstance(data, pickle.PickleBuffer):
+        return data.raw()
+    return memoryview(data)
+
+
+def is_bytes_like(data) -> bool:
+    """True for anything holding a packed frame: raw buffers or Frame."""
+    return isinstance(data, (bytes, bytearray, memoryview, Frame))
+
+
+def byte_views(parts) -> List[memoryview]:
+    """Normalize buffers to flat byte views for a vectored syscall,
+    dropping zero-length ones (declared in multiseg headers but never
+    handed to the kernel)."""
+    views = []
+    for p in parts:
+        v = memoryview(p)
+        if v.format != "B" or v.ndim != 1:
+            v = v.cast("B")
+        if v.nbytes:
+            views.append(v)
+    return views
+
+
+def advance_views(views: List[memoryview], i: int, n: int) -> int:
+    """Consume ``n`` bytes of a vectored syscall's progress from
+    ``views[i:]``, slicing the partially-consumed view in place; returns
+    the index of the first unfinished view. Shared by sendmsg (rpc) and
+    pwritev (object_store) resume loops."""
+    while n:
+        v = views[i]
+        if n >= v.nbytes:
+            n -= v.nbytes
+            i += 1
+        else:
+            views[i] = v[n:]
+            n = 0
+    return i
 
 
 def dumps_function(fn: Any) -> bytes:
@@ -102,28 +234,63 @@ def _maybe_register_by_value(module_name, _depth: int = 0) -> None:
                 _maybe_register_by_value(ref_mod, _depth + 1)
 
 
-def pack(obj: Any) -> bytes:
-    """Serialize obj into a single contiguous frame: header + meta + buffers.
+# ---------------------------------------------------------------------------
+# Contiguous object frames (the shm store format)
+# ---------------------------------------------------------------------------
+#
+# Layout: [n_bufs u32][meta_len u64][buf_len u64 * n_bufs][meta][bufs...]
+# frame_parts/frame_nbytes expose the scatter-gather pieces so writers can
+# pwritev them straight into a shm segment (write-through puts: no
+# intermediate concatenation); pack() joins them for callers that need one
+# contiguous blob.
 
-    Layout: [n_bufs u32][meta_len u64][buf_len u64 * n_bufs][meta][bufs...]
-    Used when an object must travel as one blob (shm store, network).
-    """
-    meta, views = serialize(obj)
+
+def frame_header(meta, views) -> bytes:
     parts = [
         len(views).to_bytes(4, "little"),
         len(meta).to_bytes(8, "little"),
     ]
     for v in views:
         parts.append(v.nbytes.to_bytes(8, "little"))
-    parts.append(meta)
-    parts.extend(views)
-    return b"".join(bytes(p) if isinstance(p, memoryview) else p for p in parts)
+    return b"".join(parts)
+
+
+def frame_nbytes(meta, views) -> int:
+    return 12 + 8 * len(views) + len(meta) + sum(v.nbytes for v in views)
+
+
+def frame_parts(meta, views) -> List[Any]:
+    """Scatter-gather pieces of the frame: [header, meta, *views]."""
+    return [frame_header(meta, views), meta, *views]
+
+
+def pack_parts(meta, views) -> bytes:
+    """Join (meta, views) into one contiguous frame (one host copy)."""
+    total = frame_nbytes(meta, views)
+    if copy_hook is not None:
+        note_copy(total, "pack-join")
+    return b"".join(
+        bytes(p) if isinstance(p, memoryview) else p
+        for p in frame_parts(meta, views)
+    )
+
+
+def pack(obj: Any) -> bytes:
+    """Serialize obj into a single contiguous frame: header + meta + buffers.
+
+    Used when an object must travel as one blob (shm store, network).
+    Hot paths that can write segments directly (worker put / task returns)
+    use serialize() + frame_parts() instead and skip this join.
+    """
+    meta, views = serialize(obj)
+    return pack_parts(meta, views)
 
 
 def unpack(frame) -> Any:
-    """Inverse of pack(). Accepts bytes or memoryview; buffers stay zero-copy
-    views into the frame (caller keeps the frame alive, e.g. shm mapping)."""
-    mv = memoryview(frame)
+    """Inverse of pack(). Accepts bytes, memoryview, or Frame; buffers stay
+    zero-copy views into the frame (caller keeps the frame alive, e.g. shm
+    mapping)."""
+    mv = as_view(frame)
     n_bufs = int.from_bytes(mv[0:4], "little")
     meta_len = int.from_bytes(mv[4:12], "little")
     off = 12

@@ -1,0 +1,102 @@
+"""In-band payload static check (tier-1): the zero-copy data plane's
+invariant — hot-path RPC sends never carry raw packed payloads in-band —
+must hold for the checked-in source, and the checker must keep catching
+each bypass pattern."""
+
+import os
+import sys
+import textwrap
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+sys.path.insert(0, TOOLS)
+
+from check_inband_payloads import HOT_PATHS, check_file, check_source  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_hot_paths_have_no_inband_payloads():
+    for rel in HOT_PATHS:
+        violations = check_file(os.path.join(REPO, rel))
+        assert not violations, "\n".join(violations)
+
+
+def _check(body: str):
+    return check_source(textwrap.dedent(body))
+
+
+def test_flags_direct_pack_into_call():
+    violations = _check("""
+        def send(self, value):
+            self.agent.call("store", payload=serialization.pack(value))
+    """)
+    assert len(violations) == 1 and "send()" in violations[0]
+
+
+def test_flags_pack_via_alias():
+    violations = _check("""
+        def send(self, value):
+            frame = serialization.pack(value)
+            self.owner.call_oneway("stream_item", payload=frame)
+    """)
+    assert len(violations) == 1 and "alias 'frame'" in violations[0]
+
+
+def test_flags_nested_payload_tuple():
+    violations = _check("""
+        def send(self, value):
+            self.owner.call_oneway(
+                "stream_item", payload=("frame", serialization.pack(value))
+            )
+    """)
+    assert len(violations) == 1
+
+
+def test_flags_tobytes_and_bytes_copies():
+    violations = _check("""
+        def send(self, arr, view):
+            self.peer.call("a", data=arr.tobytes())
+            self.peer.call("b", data=bytes(view))
+    """)
+    assert len(violations) == 2
+
+
+def test_flags_reply_and_push():
+    violations = _check("""
+        def handle(self, conn, req_id, value):
+            RpcServer.reply(conn, req_id, True, serialization.pack(value))
+            conn.push("topic", serialization.dumps(value))
+    """)
+    assert len(violations) == 2
+
+
+def test_wrapped_payloads_are_clean():
+    violations = _check("""
+        def send(self, value, frame):
+            self.owner.call_oneway(
+                "stream_item",
+                payload=("frame", serialization.maybe_frame(
+                    serialization.pack_parts(meta, views))),
+            )
+            self.peer.call("get", payload=serialization.Frame(frame))
+            self.peer.call("obj", payload=value)
+    """)
+    assert not violations, violations
+
+
+def test_honors_opt_out_comment():
+    violations = _check("""
+        def send(self, value):
+            self.peer.call("wal_append", rec=serialization.dumps(value))  # inband: ok
+    """)
+    assert not violations, violations
+
+
+def test_alias_chain_is_tracked():
+    violations = _check("""
+        def send(self, value):
+            blob = serialization.dumps(value)
+            rec = blob
+            self.peer.call("kv_put", value=rec)
+    """)
+    assert len(violations) == 1 and "alias 'rec'" in violations[0]

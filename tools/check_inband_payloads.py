@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Static check: hot-path RPC calls must not carry raw packed payloads
+in-band.
+
+The zero-copy data plane (utils/rpc.py multi-segment frames) only stays
+zero-copy if bulk payloads reach the RPC layer as out-of-band-capable
+values: ndarrays (pickle-5 splits them automatically) or packed frames
+wrapped in ``serialization.Frame`` / ``serialization.maybe_frame``. A
+call site that passes ``serialization.pack(...)`` / ``dumps(...)`` /
+``pack_parts(...)`` output (or ``.tobytes()`` / ``bytes(view)``) straight
+into an RPC send re-introduces the in-band memcpy this PR removed — and
+nothing would fail, it would just be slow. This checker walks the
+hot-path modules' ASTs and flags:
+
+1. a raw-serializer call (``serialization.pack/dumps/pack_parts``,
+   ``*.tobytes()``, ``bytes(<something>)``) appearing DIRECTLY as an
+   argument of an RPC send (``.call`` / ``.call_async`` /
+   ``.call_oneway`` / ``.push`` / ``.push_encoded`` / ``reply``);
+2. the same through a local alias: a name assigned from a raw
+   serializer inside the function and later passed to an RPC send
+   (alias propagation to a fixpoint, like check_wal_choke.py).
+
+Wrapping in ``serialization.Frame(...)`` / ``maybe_frame(...)`` cleans a
+value. Control-plane modules may pickle in-band freely — only the
+modules in HOT_PATHS are checked. A line may opt out with a
+``# inband: ok`` comment (e.g. the WAL append, where durability needs
+one contiguous record). Run directly or via
+tests/test_inband_check.py (tier-1).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Set
+
+HOT_PATHS = (
+    os.path.join("ray_tpu", "core", "worker.py"),
+    os.path.join("ray_tpu", "core", "node_agent.py"),
+)
+
+RPC_SEND_METHODS = {"call", "call_async", "call_oneway", "push",
+                    "push_encoded", "reply"}
+RAW_SERIALIZERS = {"pack", "dumps", "pack_parts"}
+WRAPPERS = {"Frame", "maybe_frame"}
+OPT_OUT_MARK = "# inband: ok"
+
+
+def _call_attr(node: ast.AST) -> str:
+    """Method name of a Call through an attribute, else ''. """
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def _is_raw_serializer_call(node: ast.AST) -> bool:
+    """serialization.pack(...) / dumps(...) / pack_parts(...) /
+    x.tobytes() / bytes(...)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in RAW_SERIALIZERS or fn.attr == "tobytes":
+            return True
+    if isinstance(fn, ast.Name) and fn.id == "bytes" and node.args:
+        return True
+    return False
+
+
+def _is_wrapper_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _call_attr(node) in WRAPPERS or (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in WRAPPERS
+    )
+
+
+def _raw_aliases(fn: ast.AST) -> Set[str]:
+    """Names assigned (possibly transitively) from a raw serializer call
+    within one function, to a fixpoint. A name reassigned from a wrapper
+    is NOT cleaned retroactively — one dirty binding taints the name for
+    the whole function (static over-approximation, opt out per line)."""
+    aliases: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            dirty = _is_raw_serializer_call(value) or (
+                isinstance(value, ast.Name) and value.id in aliases
+            )
+            if not dirty:
+                continue
+            for t in node.targets:
+                for sub in ast.walk(t):
+                    if (
+                        isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Store)
+                        and sub.id not in aliases
+                    ):
+                        aliases.add(sub.id)
+                        changed = True
+    return aliases
+
+
+def _payload_args(call: ast.Call):
+    for a in call.args:
+        yield a
+    for kw in call.keywords:
+        yield kw.value
+
+
+def _dirty_payloads(call: ast.Call, aliases: Set[str]):
+    """Raw-serializer expressions reaching an RPC send call's arguments,
+    at any nesting depth — but never looking INSIDE a wrapper call."""
+    stack = list(_payload_args(call))
+    while stack:
+        node = stack.pop()
+        if _is_wrapper_call(node):
+            continue  # wrapped payloads are clean, whatever is inside
+        if _is_raw_serializer_call(node):
+            yield node
+            continue
+        if isinstance(node, ast.Name) and node.id in aliases:
+            yield node
+            continue
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def check_source(src: str, filename: str = "<source>") -> List[str]:
+    tree = ast.parse(src, filename=filename)
+    lines = src.splitlines()
+    violations: List[str] = []
+
+    def opted_out(lineno: int) -> bool:
+        return 0 < lineno <= len(lines) and OPT_OUT_MARK in lines[lineno - 1]
+
+    functions = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in functions:
+        aliases = _raw_aliases(fn)
+        for node in ast.walk(fn):
+            if _call_attr(node) not in RPC_SEND_METHODS:
+                continue
+            for dirty in _dirty_payloads(node, aliases):
+                if opted_out(node.lineno) or opted_out(dirty.lineno):
+                    continue
+                what = (
+                    f"alias {dirty.id!r}" if isinstance(dirty, ast.Name)
+                    else "serializer output"
+                )
+                violations.append(
+                    f"{filename}:{node.lineno}: in {fn.name}(): raw "
+                    f"in-band payload ({what}) passed to "
+                    f".{_call_attr(node)}() — wrap in serialization.Frame/"
+                    f"maybe_frame or pass the value itself"
+                )
+    return violations
+
+
+def check_file(path: str) -> List[str]:
+    with open(path) as f:
+        return check_source(f.read(), filename=path)
+
+
+def main(argv: List[str]) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = argv[1:] or [os.path.join(repo, p) for p in HOT_PATHS]
+    violations: List[str] = []
+    for p in paths:
+        violations.extend(check_file(p))
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} in-band payload violation(s)")
+        return 1
+    print(f"{len(paths)} hot-path file(s): no in-band bulk payloads")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
