@@ -11,6 +11,8 @@ file BENCH_CORE.json with every metric.
 """
 
 import json
+import os
+import sys
 import time
 
 
@@ -24,15 +26,22 @@ def timed(fn, n, warmup=5):
     return n / dt, dt / n
 
 
-def _bench_serve_http() -> float:
+def _bench_serve_http():
     """No-op deployment behind the asyncio proxy, hammered by concurrent
     keep-alive connections (parity: reference serve microbenchmarks'
-    no-op HTTP throughput)."""
-    import http.client
-    import threading
+    no-op HTTP throughput). Two client harnesses against the SAME
+    deployment: the historical http.client loop (comparable across
+    rounds, but on a 1-core box ~110us/req of its budget is the CLIENT's
+    own Python), and a raw-socket client that isolates server capacity
+    (tools/exp_serve_profile.py stages A/B quantify the difference).
+    Returns (http_client_req_s, raw_client_req_s)."""
     import time as time_mod
 
     from ray_tpu import serve
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from exp_serve_profile import hammer_http, hammer_raw
 
     serve.start()
 
@@ -50,39 +59,24 @@ def _bench_serve_http() -> float:
         time_mod.sleep(0.2)
     host, port = addrs[0].rsplit(":", 1)
 
-    N_CONNS, N_REQS = 16, 150
-    barrier = threading.Barrier(N_CONNS + 1)
-    done = threading.Barrier(N_CONNS + 1)
-
-    def client_loop():
-        conn = http.client.HTTPConnection(host, int(port), timeout=30)
-        conn.request("GET", "/noop")
-        conn.getresponse().read()  # warm the connection + replica
-        barrier.wait()
-        for _ in range(N_REQS):
-            conn.request("GET", "/noop")
-            conn.getresponse().read()
-        done.wait()
-
-    threads = [
-        threading.Thread(target=client_loop, daemon=True)
-        for _ in range(N_CONNS)
-    ]
-    for t in threads:
-        t.start()
-    barrier.wait()
-    t0 = time_mod.perf_counter()
-    done.wait()
-    dt = time_mod.perf_counter() - t0
+    per_s = hammer_http(host, int(port))
+    per_s_raw = hammer_raw(host, int(port))
     serve.delete("Noop")
     serve.shutdown()
-    return N_CONNS * N_REQS / dt
+    return per_s, per_s_raw
 
 
 def main():
     import numpy as np
 
     import ray_tpu
+    from ray_tpu.core import cluster_utils
+
+    # leaked daemons/shm from SIGKILLed prior runs depress every number
+    # here (they share the box's core); sweep before measuring
+    swept = cluster_utils.sweep_stale_runtime()
+    if swept["killed"] or swept["removed"]:
+        print(json.dumps({"swept_stale_runtime": swept}), flush=True)
 
     # generous virtual CPU count: every actor in this suite holds a CPU
     # lease for its lifetime, and the point is to measure the core plane,
@@ -238,8 +232,9 @@ def main():
         cdag.teardown()
 
     # -- serve HTTP data plane (asyncio proxy) --------------------------
-    serve_reqs = _bench_serve_http()
+    serve_reqs, serve_reqs_raw = _bench_serve_http()
     record("serve_http_noop", serve_reqs, "req/s")
+    record("serve_http_noop_rawclient", serve_reqs_raw, "req/s")
 
     # -- RDT device objects vs pickle path ------------------------------
     import jax

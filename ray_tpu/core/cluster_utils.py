@@ -8,8 +8,12 @@ resource spec; kill/restart nodes for fault-tolerance tests.
 
 from __future__ import annotations
 
+import atexit
+import glob
 import json
 import os
+import shutil
+import signal
 import subprocess
 import sys
 import time
@@ -19,6 +23,136 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.core.control_store import ControlStore
 from ray_tpu.utils.config import config
 from ray_tpu.utils.rpc import RpcClient
+
+# Every daemon spawned through _spawn_with_handshake, for the atexit
+# sweep: a test/bench run that dies without Cluster.shutdown() (assertion
+# mid-fixture, Ctrl-C) must not leave node_main/head_main process groups
+# — and their workers' /dev/shm segments — behind.
+_SPAWNED: List[subprocess.Popen] = []
+_atexit_registered = False
+
+_DAEMON_MARKERS = (
+    "ray_tpu.core.node_main",
+    "ray_tpu.core.head_main",
+    "ray_tpu.core.worker_main",
+)
+_SHM_DEBRIS_GLOBS = (
+    "/dev/shm/rtshm_*", "/dev/shm/rtpool_*", "/dev/shm/rtchan_*",
+    "/tmp/rtspill_*",
+)
+
+
+def _kill_group(pid: int, sig: int = signal.SIGKILL) -> None:
+    try:
+        os.killpg(os.getpgid(pid), sig)
+    except (ProcessLookupError, PermissionError):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def _atexit_sweep() -> None:
+    for proc in _SPAWNED:
+        if proc.poll() is None:
+            _kill_group(proc.pid, signal.SIGTERM)
+    deadline = time.monotonic() + 3.0
+    for proc in _SPAWNED:
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if proc.poll() is None:
+            _kill_group(proc.pid, signal.SIGKILL)
+
+
+def _track_spawned(proc: subprocess.Popen) -> None:
+    global _atexit_registered
+    if not _atexit_registered:
+        atexit.register(_atexit_sweep)
+        _atexit_registered = True
+    _SPAWNED.append(proc)
+    # completed daemons need no tracking; stop the list growing unbounded
+    # in long sessions (autoscaler churn spawns many short-lived agents)
+    if len(_SPAWNED) > 256:
+        _SPAWNED[:] = [p for p in _SPAWNED if p.poll() is None]
+
+
+def sweep_stale_runtime(min_debris_age_s: float = 10.0) -> Dict[str, int]:
+    """Reap debris a SIGKILLed previous run left behind: orphaned
+    ray_tpu daemon processes (node_main/head_main/worker_main whose
+    spawning driver is gone — they reparent to pid 1) and their shm/spill
+    files (/dev/shm/rtshm_* segments, rtpool_* recycle pools, rtchan_*
+    compiled-graph channels, /tmp/rtspill_* spill dirs).
+
+    Call at test-session / bench start: leaked node_main processes hold
+    CPU and ports that cascade-fail late test_serve runs and depress
+    serve/RPC benches. Concurrent-run safety, in order: only ORPHANS die
+    (a daemon whose parent — another live pytest/bench/driver — still
+    exists is left alone); files mapped by ANY live process
+    (/proc/*/maps scan — mmap writes never touch st_mtime, so age alone
+    can't prove staleness) are skipped; files carrying the session
+    prefix of a surviving daemon are skipped; and the
+    ``min_debris_age_s`` gate protects clusters mid-boot whose files
+    exist but are not yet mapped.
+
+    Returns {"killed": n_processes, "removed": n_paths}."""
+    killed = 0
+    live_sessions: set = set()
+    mapped_paths: set = set()
+    for pid_dir in glob.glob("/proc/[0-9]*"):
+        try:
+            pid = int(os.path.basename(pid_dir))
+            if pid == os.getpid():
+                continue
+            with open(os.path.join(pid_dir, "cmdline"), "rb") as f:
+                argv = f.read().split(b"\x00")
+            cmdline = b" ".join(argv).decode(errors="replace")
+            if any(m in cmdline for m in _DAEMON_MARKERS):
+                with open(os.path.join(pid_dir, "stat")) as f:
+                    # field 4 of /proc/pid/stat is ppid; comm (field 2)
+                    # may contain spaces but is parenthesized — split
+                    # after ')'
+                    ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+                if ppid == 1 or not os.path.exists(f"/proc/{ppid}"):
+                    _kill_group(pid, signal.SIGKILL)
+                    killed += 1
+                    continue
+                # surviving daemon: remember its session so its files
+                # (incl. never-mapped recycle-pool segments) are spared
+                args = [a.decode(errors="replace") for a in argv]
+                if "--session-id" in args:
+                    sid = args[args.index("--session-id") + 1]
+                    live_sessions.add(sid[:8])
+            # any live process's mappings protect the files it holds
+            with open(os.path.join(pid_dir, "maps")) as f:
+                for line in f:
+                    if "/dev/shm/rt" in line or "/tmp/rtspill_" in line:
+                        mapped_paths.add(
+                            line.split(None, 5)[-1].strip()
+                            .replace(" (deleted)", "")
+                        )
+        except (OSError, ValueError, IndexError):
+            continue  # process vanished mid-scan
+    removed = 0
+    cutoff = time.time() - min_debris_age_s
+    for pattern in _SHM_DEBRIS_GLOBS:
+        for path in glob.glob(pattern):
+            try:
+                name = os.path.basename(path)
+                session8 = (
+                    name.split("_")[1][:8] if "_" in name else ""
+                )
+                if path in mapped_paths or session8 in live_sessions:
+                    continue  # a live run owns it
+                if os.lstat(path).st_mtime >= cutoff:
+                    continue
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+                else:
+                    os.unlink(path)
+                removed += 1
+            except OSError:
+                continue
+    return {"killed": killed, "removed": removed}
 
 
 def _spawn_with_handshake(
@@ -51,6 +185,7 @@ def _spawn_with_handshake(
         )
     finally:
         stderr_f.close()
+    _track_spawned(proc)
     import selectors
 
     sel = selectors.DefaultSelector()

@@ -332,6 +332,15 @@ class _ActorRuntime:
         self.threads: List[threading.Thread] = []
         self.running = 0  # executions in flight (guarded by running_lock)
         self.running_lock = threading.Lock()
+        # Direct-call concurrency bound (rpc_actor_direct_call): direct
+        # dispatches run on the RPC dispatcher pool, not the executor
+        # threads, so they need their OWN max_concurrency gate — without
+        # it the serve proxy's hot path would run a max_concurrency=1
+        # deployment's callable concurrently. (Mixed handle+direct
+        # traffic can still reach 2x the bound — one per path — which
+        # serve replicas tolerate; handle-only or proxy-only traffic,
+        # the common cases, see exactly max_concurrency.)
+        self.direct_sem = threading.BoundedSemaphore(max(1, max_concurrency))
         # Lazily-started asyncio loop for `async def` methods (reference:
         # async actors run coroutines on one event loop, task_execution
         # fiber/async queues): coroutines are scheduled here and the reply
@@ -2320,6 +2329,43 @@ class CoreWorker:
         fut.add_done_callback(
             lambda f: self._submit_pool.submit(_finish, f)
         )
+
+    def rpc_actor_direct_call(self, conn, target: str, args=(), kwargs=None):
+        """Latency-optimized call into the hosted actor instance for the
+        serve data plane: the proxy invokes the replica's request method
+        DIRECTLY on this server's cached dispatcher thread — no TaskSpec,
+        no return-object registration, no executor-queue hop, no owner-
+        side memory-store put. Replies ride the same multi-segment frames
+        as every RPC, so a wrapped (serialization.Frame) response body
+        ≥32 KiB travels as a raw out-of-band segment.
+
+        The actor's max_concurrency bound still applies: direct calls
+        gate on rt.direct_sem (same limit as the executor pool), so a
+        max_concurrency=1 deployment's callable never runs concurrently
+        on this path either — excess direct calls block their dispatcher
+        thread until a slot frees. Only methods designed for direct
+        dispatch (serve replicas' handle_request_direct, which do their
+        own ongoing accounting) should be targeted. The in-flight count
+        still reflects in actor_queue_stats via rt.running so the pow-2
+        router and the autoscaler keep seeing direct load.
+
+        Returns ("ok", result) or ("no_actor", reason) — the marker, not
+        an error, so the router can fall back to the ordinary actor-task
+        path without burning its retry ladder."""
+        rt = self._actor_runtime
+        if rt is None:
+            return ("no_actor", "this worker hosts no actor")
+        fn = getattr(rt.instance, target, None)
+        if fn is None:
+            return ("no_actor", f"actor has no method {target!r}")
+        with rt.direct_sem:  # the actor's max_concurrency bound
+            with rt.running_lock:
+                rt.running += 1
+            try:
+                return ("ok", fn(*args, **(kwargs or {})))
+            finally:
+                with rt.running_lock:
+                    rt.running -= 1
 
     def rpc_actor_queue_stats(self, conn):
         """Queue depth + in-flight count for the hosted actor, served by

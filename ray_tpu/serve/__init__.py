@@ -19,10 +19,18 @@ from ray_tpu.serve.api import (
     status,
 )
 from ray_tpu.serve.batching import batch
-from ray_tpu.serve.multiplex import multiplexed
+from ray_tpu.serve.multiplex import make_multiplexer, multiplexed
 from ray_tpu.serve.replica import Request
 
+# submodules, imported LAST (they import this package's API above):
+# serve.llm.deploy(...) is the OpenAI front-door entrypoint and
+# serve.openai holds its protocol/tokenizer/ingress layers
+from ray_tpu.serve import llm, openai  # noqa: E402  (cycle-safe tail import)
+
 __all__ = [
+    "llm",
+    "make_multiplexer",
+    "openai",
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",

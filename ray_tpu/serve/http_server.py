@@ -13,11 +13,28 @@ Handlers are plain callables (run in the pool, NOT on the loop):
     handler(method, path, query, headers, body)
       -> (status:int, content_type:str, payload:bytes)        # unary
       -> generator yielding bytes                             # streaming
+      -> (status:int, content_type:str, generator)            # streaming
+                                       with explicit status/content-type
+                                       (SSE: "text/event-stream")
+
+A client disconnect mid-stream CLOSES the handler's generator (on the
+pool), so producers can release held resources — the serve LLM path
+relies on this to cancel the replica-side stream and free its engine
+KV slot.
+
+Fast path: an optional ``fast_handler`` runs ON THE EVENT LOOP before
+the pool dispatch. It must never block; it returns None (take the pool
+path), a ready result, or an awaitable resolving to a result. Raising
+``FallbackToPool`` from the awaitable re-dispatches the request to the
+ordinary pool handler. The serve proxy uses this to issue the
+replica RPC asynchronously — the request then costs zero executor
+hops and no parked pool thread (PROFILE.md serve budget).
 """
 
 from __future__ import annotations
 
 import asyncio
+import inspect
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Tuple
@@ -27,10 +44,17 @@ _MAX_HEADER = 64 * 1024
 _MAX_BODY = 256 * 1024 * 1024
 
 
+class FallbackToPool(Exception):
+    """Raised by a fast-path awaitable: re-dispatch on the pool handler
+    (only safe when the request provably did NOT execute yet)."""
+
+
 class AioHttpServer:
     def __init__(self, handler: Callable, port: int = 0,
-                 host: str = "0.0.0.0", pool_size: int = 32):
+                 host: str = "0.0.0.0", pool_size: int = 32,
+                 fast_handler: Optional[Callable] = None):
         self._handler = handler
+        self._fast = fast_handler
         self._host = host
         self._port = port
         self._pool = ThreadPoolExecutor(
@@ -114,23 +138,60 @@ class AioHttpServer:
                 query = dict(parse_qsl(parsed.query))
                 keep = headers.get("connection", "keep-alive").lower() != "close"
                 loop = asyncio.get_running_loop()
-                try:
-                    result = await loop.run_in_executor(
-                        self._pool, self._handler, method, path, query,
-                        headers, body,
-                    )
-                except Exception as e:  # noqa: BLE001 — handler crash -> 500
-                    await self._simple(
-                        writer, 500,
-                        f'{{"error":"{type(e).__name__}"}}'.encode(), keep,
-                    )
-                    if not keep:
-                        return
-                    continue
+                result = None
+                if self._fast is not None:
+                    try:
+                        fast = self._fast(method, path, query, headers, body)
+                    except Exception:  # noqa: BLE001 — probe bug: pool path
+                        fast = None
+                    if fast is not None:
+                        try:
+                            result = (
+                                await fast if inspect.isawaitable(fast)
+                                else fast
+                            )
+                        except FallbackToPool:
+                            result = None
+                        except Exception as e:  # noqa: BLE001
+                            await self._simple(
+                                writer, 500,
+                                f'{{"error":"{type(e).__name__}"}}'.encode(),
+                                keep,
+                            )
+                            if not keep:
+                                return
+                            continue
+                if result is None:
+                    try:
+                        result = await loop.run_in_executor(
+                            self._pool, self._handler, method, path, query,
+                            headers, body,
+                        )
+                    except Exception as e:  # noqa: BLE001 — crash -> 500
+                        await self._simple(
+                            writer, 500,
+                            f'{{"error":"{type(e).__name__}"}}'.encode(),
+                            keep,
+                        )
+                        if not keep:
+                            return
+                        continue
                 if hasattr(result, "__next__"):  # streaming generator
-                    await self._stream(writer, result, loop)
+                    ok = await self._stream(writer, result, loop)
                     # chunked responses end the exchange cleanly; keep
                     # the connection for the next request
+                    if not ok:
+                        return  # client went away mid-stream
+                elif (
+                    isinstance(result, tuple) and len(result) == 3
+                    and hasattr(result[2], "__next__")
+                ):  # streaming with explicit status/content-type (SSE)
+                    status, ctype, gen = result
+                    ok = await self._stream(
+                        writer, gen, loop, status=status, ctype=ctype
+                    )
+                    if not ok:
+                        return
                 else:
                     status, ctype, payload = result
                     await self._respond(writer, status, ctype, payload, keep)
@@ -178,16 +239,21 @@ class AioHttpServer:
             writer, status, "application/json", payload, keep
         )
 
-    async def _stream(self, writer, gen, loop) -> None:
+    async def _stream(self, writer, gen, loop, status: int = 200,
+                      ctype: str = "application/x-ndjson") -> bool:
         """Chunked transfer encoding: one chunk per yielded bytes item.
         The (blocking) generator advances on the pool, the writes on the
-        loop."""
+        loop. Returns False when the client disconnected mid-stream —
+        the generator is CLOSED either way (its finally blocks release
+        producer resources, e.g. the LLM engine's KV slot)."""
         writer.write(
-            b"HTTP/1.1 200 OK\r\n"
-            b"Content-Type: application/x-ndjson\r\n"
+            b"HTTP/1.1 %d %s\r\n"
+            b"Content-Type: %s\r\n"
             b"Transfer-Encoding: chunked\r\n"
             b"Connection: keep-alive\r\n\r\n"
+            % (status, _REASONS.get(status, b"OK"), ctype.encode())
         )
+        alive = True
         try:
             while True:
                 item = await loop.run_in_executor(self._pool, _next_or_done, gen)
@@ -195,9 +261,19 @@ class AioHttpServer:
                     break
                 writer.write(b"%x\r\n%s\r\n" % (len(item), item))
                 await writer.drain()
+        except (ConnectionError, OSError):
+            alive = False  # client went away: stop producing NOW
         finally:
-            writer.write(b"0\r\n\r\n")
-            await writer.drain()
+            # close on the pool: generator finally blocks may issue
+            # (blocking) cancel RPCs and must not run on the event loop
+            await loop.run_in_executor(self._pool, _close_gen, gen)
+            if alive:
+                try:
+                    writer.write(b"0\r\n\r\n")
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    alive = False
+        return alive
 
 
 _DONE = object()
@@ -208,6 +284,13 @@ def _next_or_done(gen):
         return next(gen)
     except StopIteration:
         return _DONE
+
+
+def _close_gen(gen):
+    try:
+        gen.close()
+    except Exception:  # noqa: BLE001 — producer cleanup is best-effort
+        pass
 
 
 _REASONS = {

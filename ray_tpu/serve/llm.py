@@ -56,7 +56,7 @@ class LLMConfig:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "event", "result",
-                 "error", "token_q")
+                 "error", "token_q", "cancelled")
 
     def __init__(self, prompt, max_new, temperature, stream=False):
         self.prompt = prompt
@@ -65,6 +65,10 @@ class _Request:
         self.event = threading.Event()
         self.result: Optional[List[int]] = None
         self.error: Optional[BaseException] = None
+        # set when the consumer abandoned the request (client disconnect
+        # mid-stream): the engine frees the KV slot at the next round
+        # instead of decoding to max_new for nobody
+        self.cancelled = False
         # streaming consumers read tokens here as the engine produces
         # them; None marks the end of the stream
         self.token_q: Optional["queue.Queue"] = None
@@ -111,6 +115,7 @@ class LLMServer:
         self._batch_sizes = collections.deque(maxlen=1000)
         self._total_batches = 0
         self._max_batch_seen = 0
+        self._occupied = 0  # KV slots held after the last engine round
         self._stop = threading.Event()
         if config.engine == "kv":
             target = self._engine_loop_kv
@@ -160,21 +165,30 @@ class LLMServer:
     def _stream_tokens(self, req: "_Request"):
         """Token-by-token generator (continuous batching pushes each
         decoded token as its step completes; parity: vLLM's streaming
-        generate in the reference's serve.llm engine)."""
+        generate in the reference's serve.llm engine). Closing the
+        generator before exhaustion — the client disconnected — cancels
+        the request so the engine frees its KV slot."""
         import queue as queue_mod
 
         produced = 0
-        while True:
-            try:
-                tok = req.token_q.get(timeout=300)
-            except queue_mod.Empty:
-                raise TimeoutError("generation stalled") from None
-            if tok is None:
-                if req.error is not None:
-                    raise req.error
-                return
-            produced += 1
-            yield {"token": int(tok), "index": produced - 1}
+        done = False
+        try:
+            while True:
+                try:
+                    tok = req.token_q.get(timeout=300)
+                except queue_mod.Empty:
+                    raise TimeoutError("generation stalled") from None
+                if tok is None:
+                    done = True
+                    if req.error is not None:
+                        raise req.error
+                    return
+                produced += 1
+                yield {"token": int(tok), "index": produced - 1}
+        finally:
+            if not done:
+                req.cancelled = True
+                self._work.set()  # wake the engine to reap the slot
 
     def batch_stats(self, _payload=None) -> Dict[str, Any]:
         with self._lock:
@@ -185,7 +199,31 @@ class LLMServer:
             "batches": total,
             "max_batch": mx,
             "mean_batch": sum(sizes) / len(sizes) if sizes else 0,
+            "occupied": self._occupied,
         }
+
+    def unload(self) -> None:
+        """Multiplex eviction hook: stop the engine thread so an evicted
+        engine doesn't keep a decode loop (and its KV cache) alive.
+        Queued requests fail HERE and in-flight ones fail in the engine
+        loop's exit path — callers get an immediate error, not a 300s
+        timeout wait."""
+        self._stop.set()
+        self._work.set()
+        err = RuntimeError(f"engine {self.cfg.model_id!r} was unloaded")
+        while True:
+            with self._lock:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                break
+            self._fail_request(req, err)
+
+    @staticmethod
+    def _fail_request(req: "_Request", err: BaseException) -> None:
+        req.error = err
+        req.event.set()
+        if req.token_q is not None:
+            req.token_q.put(None)
 
     def _record_step(self, occupancy: int) -> None:
         with self._lock:
@@ -248,7 +286,9 @@ class LLMServer:
                 raise
             first = int(self._sample_one(logits, req.temperature))
             slots[i] = _Slot(req, len(prompt), first)
-            if req.token_q is not None:
+            if req.token_q is not None and req.max_new >= 1:
+                # zero-token completions must not leak the sampled-but-
+                # unrequested first token into the stream
                 req.token_q.put(first)
             last[i] = first
             lengths[i] = len(prompt)
@@ -281,13 +321,26 @@ class LLMServer:
             nonlocal cache_k, cache_v, dev_state, step_no
             if cache_k is None:  # rebuild after a poisoned (donated) round
                 cache_k, cache_v = dec.init_cache(mcfg, S, T_max)
+            # reap abandoned requests (client disconnected mid-stream):
+            # their KV rows go back to the free pool instead of decoding
+            # to max_new for nobody
+            for i in range(S):
+                s = slots[i]
+                if s is not None and s.req.cancelled:
+                    slots[i] = None
+                    s.req.event.set()
+                    dev_state = None
             # admit new requests into free slots (continuous batching)
             admitted = False
             for i in range(S):
                 if slots[i] is not None:
                     continue
-                with self._lock:
-                    req = self._queue.popleft() if self._queue else None
+                while True:
+                    with self._lock:
+                        req = self._queue.popleft() if self._queue else None
+                    if req is None or not req.cancelled:
+                        break
+                    req.event.set()  # cancelled while queued: never admit
                 if req is None:
                     break
                 admit(i, req)
@@ -300,6 +353,7 @@ class LLMServer:
                 if len(s.produced) >= s.req.max_new or s.length >= T_max - 1:
                     finish(i)
             active = [i for i in range(S) if slots[i] is not None]
+            self._occupied = len(active)
             if not active:
                 if not admitted:
                     self._work.wait(timeout=0.5)
@@ -394,6 +448,13 @@ class LLMServer:
                 # error — can't kill the engine thread)
                 cache_k = cache_v = None
                 time.sleep(0.05)  # don't hot-spin on a persistent fault
+        # stopped (unload): in-flight slots must fail NOW, not strand
+        # their callers until the 300s wait times out (unload() drains
+        # the queue; slots are this thread's to fail)
+        fail_inflight(
+            RuntimeError(f"engine {self.cfg.model_id!r} was unloaded")
+        )
+        self._occupied = 0
 
     def _sample_one(self, logits, temperature: float) -> int:
         import jax
@@ -505,3 +566,49 @@ def build_llm_deployment(config: Optional[LLMConfig] = None) -> Any:
         max_concurrency=config.max_concurrency,
     )
     return dep.bind(config)
+
+
+def deploy(
+    models: Any = "gpt2-tiny",
+    *,
+    name: str = "openai-llm",
+    num_replicas: int = 1,
+    route_prefix: str = "/v1",
+    tokenizer: Optional[str] = None,
+    max_engines_per_replica: int = 2,
+    max_concurrency: int = 16,
+    autoscaling_config: Optional[Dict[str, Any]] = None,
+    ray_actor_options: Optional[Dict[str, float]] = None,
+    wait_ready: bool = True,
+    ready_timeout_s: float = 300.0,
+):
+    """Run the OpenAI-compatible front door (parity: the reference's
+    ``serve.llm build_openai_app`` + ``serve.run``): a multi-replica
+    ingress deployment under ``route_prefix`` serving
+    ``/v1/completions``, ``/v1/chat/completions`` (both with SSE
+    streaming) and ``/v1/models`` over every node's HTTP proxy.
+
+    ``models`` maps OpenAI model names to engine configs — a model id
+    string, an :class:`LLMConfig`, or ``{name: LLMConfig | model_id |
+    kwargs-dict}``. Each replica loads engines lazily per model
+    (LRU-bounded at ``max_engines_per_replica``) and the router prefers
+    replicas already holding the requested model; the OpenAI ``user``
+    field pins a session to one replica's warm KV slots.
+
+    Returns the DeploymentHandle."""
+    from ray_tpu.serve.openai.ingress import build_openai_deployment
+
+    app = build_openai_deployment(
+        models,
+        name=name,
+        num_replicas=num_replicas,
+        route_prefix=route_prefix,
+        tokenizer=tokenizer,
+        max_engines_per_replica=max_engines_per_replica,
+        max_concurrency=max_concurrency,
+        autoscaling_config=autoscaling_config,
+        ray_actor_options=ray_actor_options,
+    )
+    return serve.run(
+        app, wait_ready=wait_ready, ready_timeout_s=ready_timeout_s
+    )

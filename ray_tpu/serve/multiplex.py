@@ -55,6 +55,11 @@ class _Multiplexer:
         with self._lock:
             return list(self._models)
 
+    def peek(self, model_id: str):
+        """Loaded model or None — never loads, never touches the LRU."""
+        with self._lock:
+            return self._models.get(model_id)
+
     def get(self, instance, model_id: str):
         while True:
             with self._lock:
@@ -93,6 +98,35 @@ class _Multiplexer:
             with self._lock:
                 self._loading.pop(model_id, None)
             ev.set()
+
+
+class ModelMultiplexer:
+    """Imperative multiplexer for callers that configure ``max_models``
+    at runtime (the decorator form fixes it at class-definition time).
+    ``loader(model_id)`` loads a model; get() caches it LRU-bounded and
+    the loaded set feeds the replica's multiplexed-model stats like the
+    decorator does. Build with :func:`make_multiplexer` INSIDE the
+    replica (init, not module scope): the registry entry must land in
+    the replica process for the router's warm-model affinity to see it."""
+
+    def __init__(self, mux: _Multiplexer):
+        self._mux = mux
+
+    def get(self, model_id: str):
+        return self._mux.get(None, model_id)
+
+    def peek(self, model_id: str):
+        return self._mux.peek(model_id)
+
+    def model_ids(self):
+        return self._mux.model_ids()
+
+
+def make_multiplexer(loader: Callable, max_models: int = 3) -> ModelMultiplexer:
+    mux = _Multiplexer(loader, max_models)
+    with _REGISTRY_LOCK:
+        _REGISTRY[id(mux)] = mux
+    return ModelMultiplexer(mux)
 
 
 def multiplexed(_fn: Optional[Callable] = None, *,

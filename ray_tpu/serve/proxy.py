@@ -8,7 +8,20 @@ Data plane: asyncio (ray_tpu/serve/http_server.py) — the reference's
 proxy is ASGI/asyncio (proxy.py:732), and the round-4 review flagged the
 previous thread-per-request stdlib server as the gap. Connections are
 event-driven with keep-alive; the blocking replica call runs on a
-bounded pool; ?stream=1 responses ride chunked transfer encoding.
+bounded pool; streamed responses ride chunked transfer encoding.
+
+Hot path: replica calls go over ONE direct RPC to the replica's hosting
+worker (router.call_direct → rpc_actor_direct_call) on the multi-segment
+wire format + cached dispatcher pool — no TaskSpec, no owner-side object
+store (PROFILE.md "Serve no-op front-door budget"). config.serve_direct_rpc
+switches the old actor-task path back on.
+
+OpenAI front door: paths shaped like `/v1/completions`,
+`/v1/chat/completions` and `/v1/models` get a cheap body probe
+(serve/openai/protocol.py) for the routing hints that live in the JSON
+body — the ``stream`` flag (SSE, not ?stream=1), the ``model`` id
+(multiplexed warm-engine affinity) and the ``user`` session key
+(rendezvous KV affinity). Errors on those routes are OpenAI-shaped.
 
 Model multiplexing: a request carrying a ``serve_multiplexed_model_id``
 header (or ``model_id`` query param) is routed preferentially to a
@@ -17,14 +30,25 @@ replica that already holds that model (reference multiplex routing).
 
 from __future__ import annotations
 
+import asyncio
 import json
 from typing import Optional
 
 import ray_tpu
-from ray_tpu.serve.http_server import AioHttpServer
+from ray_tpu.serve.http_server import AioHttpServer, FallbackToPool
+from ray_tpu.serve.openai import protocol as oai
 from ray_tpu.serve.replica import Request
+from ray_tpu.utils.rpc import RpcError, RpcTimeout
+
+# NOTE: this class is cloudpickled BY VALUE (the @ray_tpu.remote wrapper
+# shadows the module attribute, so by-reference lookup fails): methods
+# must not reference module globals that hold _thread.locks — the config
+# registry is imported at call time for exactly that reason.
 
 _MODEL_ID_HEADER = "serve_multiplexed_model_id"
+# bodies past this stay off the fast path: the request frame is sent on
+# the event loop thread, which must never sit in a long sendmsg
+_FAST_MAX_BODY = 64 * 1024
 
 
 @ray_tpu.remote
@@ -34,22 +58,134 @@ class ServeProxy:
 
         controller = ray_tpu.get_actor(controller_name)
         self._router = Router(controller)
-        self._server = AioHttpServer(self._handle, port=port)
+        self._server = AioHttpServer(
+            self._handle, port=port, fast_handler=self._try_fast
+        )
+
+    # -- fast path (runs ON the event loop; must never block) ------------
+
+    def _try_fast(self, method, path, query, headers, body: bytes):
+        """Zero-executor-hop dispatch for unary requests whose replica is
+        instantly routable: pick from the router's cached table, fire the
+        direct RPC asynchronously, and await the reply as a loop future.
+        Anything not instantly serviceable (streaming, stale table, cold
+        actor-address cache, oversized body, feature off) returns None —
+        the ordinary pool handler takes it."""
+        from ray_tpu.utils.config import config
+
+        if not config.serve_direct_rpc or len(body) > _FAST_MAX_BODY:
+            return None
+        if query.get("stream") in ("1", "true"):
+            return None
+        if path.startswith("/-/"):
+            return None  # admin endpoints touch router internals
+        probe = oai.probe(method, path, body, headers)
+        if probe is not None and probe.stream:
+            return None
+        if probe is not None:
+            model_id, session_key = probe.model, probe.session_key
+        else:
+            model_id = (
+                headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
+            )
+            session_key = None
+        picked = self._router.try_pick_nowait(path, model_id, session_key)
+        if picked is None:
+            return None
+        deployment, rid, handle = picked
+        from ray_tpu.core import worker as worker_mod
+
+        w = worker_mod.global_worker()
+        addr = w._actor_addr_cache.get(handle._actor_id)
+        client = w.workers.get(addr) if addr is not None else None
+        if client is None or client._sock is None:
+            # cold address/connection: resolving would block the loop
+            self._router.request_finished(rid)
+            return None
+        request = Request(method, path, body, headers, query)
+        try:
+            pending = client.call_async(
+                "actor_direct_call", target="handle_request_direct",
+                args=(request,),
+            )
+        except RpcError:
+            self._router.request_finished(rid)
+            return None  # connection just dropped: pool path re-routes
+        return self._await_direct(pending, rid, openai=probe is not None)
+
+    async def _await_direct(self, pending, rid: str, openai: bool):
+        from ray_tpu.serve.router import Router
+        from ray_tpu.utils.rpc import RemoteError
+
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+
+        def _deliver(p):
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(p) if not fut.done() else None
+            )
+
+        pending.add_done_callback(_deliver)
+        try:
+            try:
+                p = await asyncio.wait_for(fut, timeout=120)
+            except asyncio.TimeoutError:
+                return 503, "application/json", (
+                    oai.error_body("request timed out",
+                                   err_type="overloaded_error")
+                    if openai else b'{"error":"request timed out"}'
+                )
+            if not p.ok:
+                if isinstance(p.payload, RemoteError):
+                    # the request EXECUTED and raised: a real 500, never
+                    # re-dispatched (double execution)
+                    msg = f"RemoteError: {p.payload}"
+                    return 500, "application/json", (
+                        oai.error_body(msg, err_type="internal_error")
+                        if openai else json.dumps({"error": msg}).encode()
+                    )
+                # connection lost: re-route on the pool path (same
+                # retry-on-replica-death semantics as router.call)
+                raise FallbackToPool
+            reply = p.payload
+            if reply[0] == "no_actor":
+                raise FallbackToPool  # mid-restart: pool path re-routes
+            result = Router._unwrap_direct(reply[1])
+            if openai:
+                return oai.split_http_result(result)
+            if isinstance(result, (bytes, bytearray, memoryview)):
+                return 200, "application/json", result
+            if (
+                isinstance(result, tuple) and len(result) == 3
+                and isinstance(result[0], int)
+            ):
+                return result
+            return 200, "application/json", json.dumps(result).encode()
+        finally:
+            self._router.request_finished(rid)
 
     # -- request path (runs on the server's executor pool) --------------
 
     def _handle(self, method: str, path: str, query, headers, body: bytes):
+        probe = oai.probe(method, path, body, headers)
+        if probe is not None:
+            return self._handle_openai(method, path, query, headers, body,
+                                       probe)
         if query.get("stream") in ("1", "true"):
             return self._handle_streaming(method, path, query, headers, body)
         try:
-            status, payload = self._dispatch(method, path, query, headers, body)
-        except TimeoutError as e:
-            status, payload = 503, json.dumps({"error": str(e)}).encode()
+            status, ctype, payload = self._dispatch(
+                method, path, query, headers, body
+            )
+        except (TimeoutError, RpcTimeout) as e:
+            status, ctype, payload = 503, "application/json", json.dumps(
+                {"error": str(e)}
+            ).encode()
         except Exception as e:  # noqa: BLE001 — app errors -> 500
-            status, payload = 500, json.dumps(
+            status, ctype, payload = 500, "application/json", json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}
             ).encode()
-        return status, "application/json", payload
+        return status, ctype, payload
 
     def _handle_streaming(self, method, path, query, headers, body):
         """?stream=1: a generator — the asyncio server turns each yielded
@@ -78,30 +214,89 @@ class ServeProxy:
 
         return gen()
 
+    # -- OpenAI front door ----------------------------------------------
+
+    def _handle_openai(self, method, path, query, headers, body,
+                       probe: "oai.Probe"):
+        """`/v1/*`-shaped requests: body-probed routing hints, SSE when
+        the body says ``stream: true``, OpenAI-shaped errors."""
+        deployment = self._router.deployment_for_route(path)
+        if deployment is None:
+            return 404, "application/json", oai.error_body(
+                f"no route for {path}", err_type="invalid_request_error",
+                code="route_not_found",
+            )
+        request = Request(method, path, body, headers, query)
+        if probe.stream:
+            return self._openai_stream(deployment, request, probe)
+        try:
+            result = self._router.call_direct(
+                deployment, request, timeout_s=300,
+                model_id=probe.model, session_key=probe.session_key,
+            )
+        except (TimeoutError, RpcTimeout) as e:
+            return 503, "application/json", oai.error_body(
+                str(e), err_type="overloaded_error"
+            )
+        except Exception as e:  # noqa: BLE001
+            return 500, "application/json", oai.error_body(
+                f"{type(e).__name__}: {e}", err_type="internal_error"
+            )
+        return oai.split_http_result(result)
+
+    def _openai_stream(self, deployment: str, request: Request,
+                       probe: "oai.Probe"):
+        """SSE response: each yielded ``data: {...}\\n\\n`` event is one
+        chunk; closing the connection closes this generator, which
+        cancels the replica-side stream and frees the engine's KV slot."""
+
+        def gen():
+            try:
+                for item in self._router.call_streaming(
+                    deployment, request, timeout_s=600,
+                    model_id=probe.model, session_key=probe.session_key,
+                ):
+                    yield item if isinstance(item, bytes) else oai.sse_event(
+                        item
+                    )
+            except Exception as e:  # noqa: BLE001 — mid-stream trailer
+                yield oai.sse_error(f"{type(e).__name__}: {e}")
+
+        return 200, oai.SSE_CONTENT_TYPE, gen()
+
+    # -- generic dispatch ------------------------------------------------
+
     def _dispatch(self, method: str, path: str, query, headers, body: bytes):
         if path == "/-/routes":
             self._router._refresh(force=True)
-            return 200, json.dumps(
+            return 200, "application/json", json.dumps(
                 {
                     name: dep["route_prefix"]
                     for name, dep in self._router._table.items()
                 }
             ).encode()
         if path == "/-/healthz":
-            return 200, b'"ok"'
+            return 200, "application/json", b'"ok"'
         deployment = self._router.deployment_for_route(path)
         if deployment is None:
-            return 404, json.dumps({"error": f"no route for {path}"}).encode()
+            return 404, "application/json", json.dumps(
+                {"error": f"no route for {path}"}
+            ).encode()
         model_id: Optional[str] = (
             headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
         )
         request = Request(method, path, body, headers, query)
-        result = self._router.call(
+        result = self._router.call_direct(
             deployment, request, timeout_s=120, model_id=model_id
         )
-        if isinstance(result, bytes):
-            return 200, result
-        return 200, json.dumps(result).encode()
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            return 200, "application/json", result
+        if (
+            isinstance(result, tuple) and len(result) == 3
+            and isinstance(result[0], int)
+        ):
+            return result
+        return 200, "application/json", json.dumps(result).encode()
 
     def address(self) -> str:
         from ray_tpu.core import worker as worker_mod

@@ -71,6 +71,34 @@ class ServeReplica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_direct(self, payload: Any, *,
+                              method: Optional[str] = None):
+        """Proxy hot-path entry (worker rpc_actor_direct_call): same
+        semantics as handle_request, but the result is wrapped so bulk
+        response bodies ride the RPC reply as out-of-band multi-segment
+        frames instead of being re-pickled in-band:
+
+          ("raw",  body)                  bytes-like response
+          ("http", (status, ctype, body)) explicit HTTP triple
+          ("obj",  value)                 anything else (JSON-encoded by
+                                          the proxy)
+
+        where ``body`` is serialization.maybe_frame output — a Frame
+        once it crosses the 32 KiB out-of-band floor."""
+        from ray_tpu.utils import serialization
+
+        result = self.handle_request(payload, method=method)
+        if isinstance(result, (bytes, bytearray)):
+            return ("raw", serialization.maybe_frame(result))
+        if (
+            isinstance(result, tuple) and len(result) == 3
+            and isinstance(result[0], int)
+            and isinstance(result[2], (bytes, bytearray))
+        ):
+            status, ctype, body = result
+            return ("http", (status, ctype, serialization.maybe_frame(body)))
+        return ("obj", result)
+
     @ray_tpu.method(num_returns="streaming")
     def handle_request_streaming(self, payload: Any, *,
                                  method: Optional[str] = None):
@@ -88,8 +116,10 @@ class ServeReplica:
             result = target(payload)
             if result is None:
                 return
-            if isinstance(result, (bytes, str, dict)):
-                yield result  # non-iterable response: one chunk
+            if isinstance(result, (bytes, str, dict, tuple)):
+                # non-iterable response (a tuple is an HTTP triple, not a
+                # stream): one chunk
+                yield result
                 return
             yield from result
         finally:

@@ -101,6 +101,11 @@ config.define("rpc_multiseg", True)
 # Fault injection: "Service.Method:p_request:p_response" comma list
 # (mirror of RAY_testing_rpc_failure, src/ray/common/ray_config_def.h:862).
 config.define("testing_rpc_failure", "")
+# Serve proxy → replica hot path: one direct RPC to the hosting worker
+# (rpc_actor_direct_call) instead of the actor-task machinery. Off =
+# every proxied request takes the ordinary submit/reply path (the
+# mixed-version escape hatch, and the A/B lever for bench_core).
+config.define("serve_direct_rpc", True)
 config.define("health_check_period_s", 1.0)
 config.define("health_check_timeout_s", 10.0)
 config.define("max_direct_call_object_size", 100 * 1024)

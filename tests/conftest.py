@@ -25,6 +25,18 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 run (-m 'not slow')"
     )
+    # Reap debris from SIGKILLed prior runs (orphaned node_main/worker
+    # daemons + /dev/shm/rtshm_* segments): leaked daemons hold CPU and
+    # cascade-fail serve tests late in the suite. Safe concurrently —
+    # only processes whose spawning driver is GONE are killed.
+    from ray_tpu.core import cluster_utils
+
+    swept = cluster_utils.sweep_stale_runtime()
+    if swept["killed"] or swept["removed"]:
+        print(
+            f"[conftest] swept stale runtime: {swept['killed']} orphaned "
+            f"daemon(s), {swept['removed']} shm/spill path(s)"
+        )
 
 
 @pytest.fixture(scope="session")

@@ -100,3 +100,65 @@ def test_alias_chain_is_tracked():
             self.peer.call("kv_put", value=rec)
     """)
     assert len(violations) == 1 and "alias 'rec'" in violations[0]
+
+
+# -- rule 3: RPC reply producers (serve proxy→replica hot path) ----------
+
+
+def test_flags_raw_return_from_rpc_handler():
+    violations = _check("""
+        def rpc_serve_call(self, conn, payload):
+            return ("ok", serialization.pack(payload))
+    """)
+    assert len(violations) == 1 and "RPC reply" in violations[0]
+
+
+def test_flags_raw_return_from_handle_request_direct():
+    violations = _check("""
+        def handle_request_direct(self, payload, method=None):
+            result = self.handle_request(payload, method=method)
+            return ("raw", result.tobytes())
+    """)
+    assert len(violations) == 1 and "handle_request_direct()" in violations[0]
+
+
+def test_flags_aliased_return_from_rpc_handler():
+    violations = _check("""
+        def rpc_read_chunk(self, conn, oid):
+            blob = serialization.pack(self.store[oid])
+            return blob
+    """)
+    assert len(violations) == 1 and "alias 'blob'" in violations[0]
+
+
+def test_wrapped_return_is_clean():
+    violations = _check("""
+        def handle_request_direct(self, payload, method=None):
+            result = self.handle_request(payload, method=method)
+            if isinstance(result, bytes):
+                return ("raw", serialization.maybe_frame(result))
+            return ("obj", result)
+    """)
+    assert not violations, violations
+
+
+def test_non_reply_functions_may_return_packed():
+    # only rpc_*/DIRECT_REPLY_FNS returns are replies; an internal helper
+    # returning packed bytes (e.g. for the WAL) is not a wire payload
+    violations = _check("""
+        def _encode_record(self, value):
+            return serialization.dumps(value)
+    """)
+    assert not violations, violations
+
+
+def test_nested_generator_returns_are_not_replies():
+    # a streaming closure inside an rpc_ handler replies via stream_item
+    # pushes (already rule-1 checked), not via its return value
+    violations = _check("""
+        def rpc_stream(self, conn, payload):
+            def gen():
+                return serialization.pack(payload)
+            return ("ok", None)
+    """)
+    assert not violations, violations

@@ -139,6 +139,31 @@ def test_llm_streaming_tokens_match_batch(rt):
     serve.delete("llm-gpt2-tiny")
 
 
+def test_large_response_body_roundtrips(rt):
+    """A bulk bytes response crosses the proxy→replica direct RPC as a
+    Frame (out-of-band multiseg segment past 32 KiB) and reaches the
+    HTTP client intact."""
+    payload = bytes(range(256)) * 1024  # 256 KiB, position-dependent
+
+    @serve.deployment(num_replicas=1, route_prefix="/blob")
+    class Blob:
+        def __call__(self, request):
+            return bytes(range(256)) * 1024
+
+    serve.run(Blob.bind())
+    deadline = time.monotonic() + 30
+    addrs = []
+    while time.monotonic() < deadline and not addrs:
+        addrs = serve.proxy_addresses()
+        time.sleep(0.2)
+    with urllib.request.urlopen(
+        f"http://{addrs[0]}/blob", timeout=60
+    ) as resp:
+        body = resp.read()
+    assert body == payload
+    serve.delete("Blob")
+
+
 def test_replica_death_recovery(rt):
     @serve.deployment(num_replicas=2)
     def ping(req):

@@ -1,0 +1,270 @@
+"""Unit tests pinning the _NormalTaskSubmitter state machine (worker.py).
+
+The lease cache was previously only covered end-to-end (VERDICT Weak
+#10); these tests drive the state machine directly with a fake worker so
+each transition is pinned in isolation:
+
+  - chunking ladder (_take_chunk_locked): sub-5ms functions coalesce up
+    to the cap, slow/unmeasured functions ride alone, a batch stops at a
+    function whose latency profile differs, cancelled specs are consumed
+    without entering a chunk;
+  - stall detection (_scale_locked): an old in-flight dispatch overrides
+    a stale-low EMA and scales the pool immediately (and wide), while
+    the un-stalled path ramps exponentially and respects request spacing;
+  - dispose / re-register: an empty submitter becomes disposable only
+    after the idle window, try_dispose re-verifies emptiness, submit()
+    on a disposed submitter refuses (the caller mints a fresh one — the
+    janitor-race contract _enqueue_normal_task relies on).
+"""
+
+import threading
+import time
+from collections import deque
+
+import pytest
+
+from ray_tpu.core.task import TaskSpec
+from ray_tpu.core.worker import _Lease, _NormalTaskSubmitter
+from ray_tpu.utils.config import config
+
+
+class FakePool:
+    """Records submissions instead of running them (the real pool only
+    carries _drain_sends/_acquire_lease/_release thunks)."""
+
+    def __init__(self):
+        self.jobs = []
+
+    def submit(self, fn, *args):
+        self.jobs.append((fn, args))
+
+    def names(self):
+        return [fn.__name__ for fn, _ in self.jobs]
+
+
+class FakeClientPool:
+    def __init__(self):
+        self.calls = []
+
+    def get(self, addr):
+        return self
+
+    def drop(self, addr):
+        pass
+
+    def call_oneway(self, method, **kwargs):
+        self.calls.append((method, kwargs))
+
+
+class FakeWorker:
+    def __init__(self):
+        self._submit_pool = FakePool()
+        self._inflight_push = {}
+        self._cancelled_tasks = set()
+        self._shutdown = threading.Event()
+        self.workers = FakeClientPool()
+        self.agents = FakeClientPool()
+        self.errors = []
+
+    def _store_error_returns(self, spec, err):
+        self.errors.append((spec, err))
+
+
+class _Tid:
+    def __init__(self, hexstr):
+        self._h = hexstr
+
+    def hex(self):
+        return self._h
+
+
+def spec(name, fn_id="fn", task_hex=None):
+    return TaskSpec(
+        task_id=_Tid(task_hex or f"t_{name}"),
+        fn_id=fn_id, fn_name=name, args_frame=b"", num_returns=1,
+        owner_address="owner:0", resources={"CPU": 1.0}, name=name,
+    )
+
+
+@pytest.fixture
+def sub():
+    w = FakeWorker()
+    s = _NormalTaskSubmitter(w, {"CPU": 1.0}, None)
+    return w, s
+
+
+# ---------------------------------------------------------------------------
+# chunking ladder
+# ---------------------------------------------------------------------------
+
+
+def test_fast_fns_coalesce_into_one_chunk(sub):
+    w, s = sub
+    s._fn_lat["fast"] = 0.001  # measured sub-5ms: batchable
+    with s.lock:
+        s.pending = deque(spec(f"a{i}", fn_id="fast") for i in range(8))
+        chunk = s._take_chunk_locked()
+    assert [c.fn_name for c in chunk] == [f"a{i}" for i in range(8)]
+    assert not s.pending
+
+
+def test_unmeasured_fn_rides_alone(sub):
+    # the 10ms prior is above the 5ms batching gate: a function with no
+    # latency history must never execute serially behind batch peers
+    w, s = sub
+    with s.lock:
+        s.pending = deque(spec(f"a{i}", fn_id="new_fn") for i in range(4))
+        chunk = s._take_chunk_locked()
+    assert len(chunk) == 1
+    assert len(s.pending) == 3
+
+
+def test_slow_fn_rides_alone(sub):
+    w, s = sub
+    s._fn_lat["slow"] = 0.5
+    with s.lock:
+        s.pending = deque(spec(f"s{i}", fn_id="slow") for i in range(3))
+        chunk = s._take_chunk_locked()
+    assert len(chunk) == 1
+
+
+def test_batch_stops_at_differing_profile(sub):
+    # fast, fast, SLOW, fast: the chunk takes the fast prefix and stops —
+    # the slow one must not ride (and the trailing fast one stays queued
+    # behind it, preserving order)
+    w, s = sub
+    s._fn_lat["fast"] = 0.001
+    s._fn_lat["slow"] = 0.1
+    with s.lock:
+        s.pending = deque([
+            spec("f1", fn_id="fast"), spec("f2", fn_id="fast"),
+            spec("s1", fn_id="slow"), spec("f3", fn_id="fast"),
+        ])
+        chunk = s._take_chunk_locked()
+    assert [c.fn_name for c in chunk] == ["f1", "f2"]
+    assert [c.fn_name for c in s.pending] == ["s1", "f3"]
+
+
+def test_chunk_cap_divides_queue_across_idle_leases(sub):
+    # 16 queued, 3 more idle leases waiting: the cap (pending // (idle+1))
+    # spreads the queue instead of letting one lease swallow it
+    w, s = sub
+    s._fn_lat["fast"] = 0.001
+    with s.lock:
+        s.pending = deque(spec(f"a{i}", fn_id="fast") for i in range(16))
+        s.idle = [object(), object(), object()]
+        chunk = s._take_chunk_locked()
+    assert len(chunk) == 4
+
+
+def test_cancelled_specs_consumed_not_chunked(sub):
+    w, s = sub
+    s._fn_lat["fast"] = 0.001
+    cancelled = spec("dead", fn_id="fast", task_hex="t_dead")
+    w._cancelled_tasks.add("t_dead")
+    with s.lock:
+        s.pending = deque([cancelled, spec("live", fn_id="fast")])
+        chunk = s._take_chunk_locked()
+    assert [c.fn_name for c in chunk] == ["live"]
+    assert len(w.errors) == 1 and w.errors[0][0] is cancelled
+
+
+# ---------------------------------------------------------------------------
+# stall detection / pool sizing
+# ---------------------------------------------------------------------------
+
+
+def test_stall_detection_scales_past_ema(sub):
+    # EMA says 10ms, but the oldest in-flight dispatch is 5s old: the
+    # pool is provably stuck behind long tasks — scale NOW, one lease per
+    # stuck-or-queued task, ignoring the request-spacing timer
+    w, s = sub
+    with s.lock:
+        s.pending = deque(spec(f"q{i}") for i in range(4))
+        s.nbusy = 2
+        s._dispatch_ts = {"t_old": time.monotonic() - 5.0}
+        s._next_request_at = time.monotonic() + 10.0  # spacing must not gate
+        s._scale_locked()
+    # want = pending + nbusy = 6, minus the 2 held → 4 new acquisitions
+    assert s.requesting == 4
+    assert s.w._submit_pool.names().count("_acquire_lease") == 4
+
+
+def test_unstalled_ramp_is_exponential_and_spaced(sub):
+    w, s = sub
+    with s.lock:
+        s.pending = deque(spec(f"q{i}") for i in range(100))
+        s.idle = [
+            _Lease("agent:0", f"w{i}:0", f"l{i}") for i in range(2)
+        ]
+        s._svc_latency = 1.0  # 100 tasks * 1s / rampup target >> held
+        s._scale_locked()
+    # held=2 → at most doubles (want≤4) → need = 4-2-0 = 2 new requests
+    assert s.requesting == 2
+    with s.lock:
+        fired_at = s._next_request_at
+        s._scale_locked()  # spacing timer gates an immediate second wave
+    assert s.requesting == 2 and fired_at > time.monotonic()
+
+
+def test_empty_queue_never_scales(sub):
+    w, s = sub
+    with s.lock:
+        s._scale_locked()
+    assert s.requesting == 0 and not s.w._submit_pool.jobs
+
+
+# ---------------------------------------------------------------------------
+# dispose / re-register
+# ---------------------------------------------------------------------------
+
+
+def test_maintain_tick_reaps_idle_leases_and_reports_disposable(sub):
+    w, s = sub
+    old = _Lease("agent:0", "w1:0", "lease1")
+    old.idle_since = time.monotonic() - float(config.lease_keepalive_s) - 1
+    warm = _Lease("agent:0", "w2:0", "lease2")
+    with s.lock:
+        s.idle = [old, warm]
+    assert s.maintain_tick() is False  # warm lease still held → not empty
+    assert ("release_worker", {"lease_id": "lease1", "kill": False}) in (
+        w.agents.calls
+    )
+    with s.lock:
+        assert s.idle == [warm]
+
+
+def test_dispose_requires_empty_past_window(sub):
+    w, s = sub
+    assert s.maintain_tick() is False  # empty, but the 60s window not up
+    s._empty_since = time.monotonic() - 61.0
+    assert s.maintain_tick() is True
+    # still-queued work blocks disposal even past the window
+    with s.lock:
+        s.pending.append(spec("late"))
+    assert s.try_dispose() is False
+    with s.lock:
+        s.pending.clear()
+    assert s.try_dispose() is True
+
+
+def test_submit_after_dispose_refuses(sub):
+    # the janitor-race contract: a submit that loses to the disposal
+    # sweep gets False and _enqueue_normal_task mints a fresh submitter
+    w, s = sub
+    assert s.try_dispose() is True
+    assert s.submit(spec("x")) is False
+    with s.lock:
+        assert not s.pending  # refused submits must not strand specs
+
+
+def test_submit_on_live_submitter_plans_and_kicks_sender(sub):
+    w, s = sub
+    lease = _Lease("agent:0", "w1:0", "lease1")
+    with s.lock:
+        s.idle = [lease]
+    assert s.submit(spec("go")) is True
+    # the idle lease was reserved for the spec and the send handed to the
+    # pool (sends happen OFF the submit thread so bursts coalesce)
+    assert s.nbusy == 1
+    assert "_drain_sends" in s.w._submit_pool.names()

@@ -18,7 +18,14 @@ hot-path modules' ASTs and flags:
    ``.call_oneway`` / ``.push`` / ``.push_encoded`` / ``reply``);
 2. the same through a local alias: a name assigned from a raw
    serializer inside the function and later passed to an RPC send
-   (alias propagation to a fixpoint, like check_wal_choke.py).
+   (alias propagation to a fixpoint, like check_wal_choke.py);
+3. the same in a ``return`` of an RPC REPLY producer — a function named
+   ``rpc_*`` or in DIRECT_REPLY_FNS (the serve replicas'
+   ``handle_request_direct``): its return value IS the RPC response
+   payload, so a raw packed blob returned there rides the wire in-band
+   exactly like a dirty send argument. This covers the serve
+   proxy→replica hot path, where response bodies ≥32 KiB must travel
+   as out-of-band segments (wrap in ``serialization.maybe_frame``).
 
 Wrapping in ``serialization.Frame(...)`` / ``maybe_frame(...)`` cleans a
 value. Control-plane modules may pickle in-band freely — only the
@@ -38,12 +45,17 @@ from typing import List, Set
 HOT_PATHS = (
     os.path.join("ray_tpu", "core", "worker.py"),
     os.path.join("ray_tpu", "core", "node_agent.py"),
+    os.path.join("ray_tpu", "serve", "proxy.py"),
+    os.path.join("ray_tpu", "serve", "replica.py"),
+    os.path.join("ray_tpu", "serve", "router.py"),
 )
 
 RPC_SEND_METHODS = {"call", "call_async", "call_oneway", "push",
                     "push_encoded", "reply"}
 RAW_SERIALIZERS = {"pack", "dumps", "pack_parts"}
 WRAPPERS = {"Frame", "maybe_frame"}
+# reply producers: the return value travels as the RPC response payload
+DIRECT_REPLY_FNS = {"handle_request_direct"}
 OPT_OUT_MARK = "# inband: ok"
 
 
@@ -116,7 +128,13 @@ def _payload_args(call: ast.Call):
 def _dirty_payloads(call: ast.Call, aliases: Set[str]):
     """Raw-serializer expressions reaching an RPC send call's arguments,
     at any nesting depth — but never looking INSIDE a wrapper call."""
-    stack = list(_payload_args(call))
+    yield from _dirty_payloads_expr(list(_payload_args(call)), aliases)
+
+
+def _dirty_payloads_expr(root, aliases: Set[str]):
+    """Raw-serializer expressions anywhere in an expression (or list of
+    expressions), never looking INSIDE a wrapper call."""
+    stack = list(root) if isinstance(root, list) else [root]
     while stack:
         node = stack.pop()
         if _is_wrapper_call(node):
@@ -160,6 +178,35 @@ def check_source(src: str, filename: str = "<source>") -> List[str]:
                     f"in-band payload ({what}) passed to "
                     f".{_call_attr(node)}() — wrap in serialization.Frame/"
                     f"maybe_frame or pass the value itself"
+                )
+        if not (fn.name.startswith("rpc_") or fn.name in DIRECT_REPLY_FNS):
+            continue
+        # reply producers: returns are response payloads (rule 3). Only
+        # THIS function's returns — nested defs (closures, streaming
+        # generators) reply through other channels.
+        nested = {
+            inner
+            for outer in ast.walk(fn)
+            if isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and outer is not fn
+            for inner in ast.walk(outer)
+        }
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            if node in nested:
+                continue
+            for dirty in _dirty_payloads_expr(node.value, aliases):
+                if opted_out(node.lineno) or opted_out(dirty.lineno):
+                    continue
+                what = (
+                    f"alias {dirty.id!r}" if isinstance(dirty, ast.Name)
+                    else "serializer output"
+                )
+                violations.append(
+                    f"{filename}:{node.lineno}: in {fn.name}(): raw "
+                    f"in-band payload ({what}) returned as an RPC reply "
+                    f"— wrap in serialization.Frame/maybe_frame"
                 )
     return violations
 

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Serve no-op front-door profile: where the request budget goes.
+
+Decomposes the `serve_http_noop` bench (bench_core.py) end-to-end:
+
+  stage A  raw asyncio HTTP server + executor hop, trivial handler
+           (the ceiling of http_server.py alone, no serve at all)
+  stage B  bench client cost (http.client against stage A's server —
+           on a 1-core box the CLIENT shares the core with the server)
+  stage C  router probe: deployment_for_route + choose_replica +
+           request_finished, in-process
+  stage D  proxy→replica hop, DIRECT path: resolve + one
+           rpc_actor_direct_call round trip (multiseg frames +
+           dispatcher pool)
+  stage E  proxy→replica hop, ACTOR-TASK path: router.call — TaskSpec,
+           actor sender/waiter threads, owner memory store
+  stage F  end-to-end serve_http_noop with the direct path ON vs OFF
+           (RT_SERVE_DIRECT_RPC), same 16-conn keep-alive harness
+
+Run: python tools/exp_serve_profile.py           (all stages)
+     RT_SERVE_DIRECT_RPC=0 python tools/...      (flip F's default)
+
+Results land in PROFILE.md ("Serve no-op front-door budget").
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def hammer_http(host, port, path="/noop", n_conns=16, n_reqs=150):
+    """The bench_core serve harness, reusable against any HTTP server."""
+    import http.client
+
+    barrier = threading.Barrier(n_conns + 1)
+    done = threading.Barrier(n_conns + 1)
+
+    def client_loop():
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", path)
+        conn.getresponse().read()
+        barrier.wait()
+        for _ in range(n_reqs):
+            conn.request("GET", path)
+            conn.getresponse().read()
+        done.wait()
+
+    threads = [
+        threading.Thread(target=client_loop, daemon=True)
+        for _ in range(n_conns)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    done.wait()
+    dt = time.perf_counter() - t0
+    return n_conns * n_reqs / dt
+
+
+def hammer_raw(host, port, path="/noop", n_conns=16, n_reqs=150):
+    """Same load, minimal client: pre-built request bytes over a raw
+    socket, fixed-size response parse — isolates SERVER capacity from
+    http.client's per-request Python overhead."""
+    import socket
+
+    req = (
+        f"GET {path} HTTP/1.1\r\nHost: x\r\nAccept-Encoding: identity\r\n\r\n"
+    ).encode()
+    barrier = threading.Barrier(n_conns + 1)
+    done = threading.Barrier(n_conns + 1)
+
+    def read_response(sock, buf):
+        while b"\r\n\r\n" not in buf:
+            buf += sock.recv(65536)
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        clen = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                clen = int(line.split(b":")[1])
+        while len(rest) < clen:
+            rest += sock.recv(65536)
+        return rest[clen:]
+
+    def client_loop():
+        sock = socket.create_connection((host, port), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.sendall(req)
+        buf = read_response(sock, b"")
+        barrier.wait()
+        for _ in range(n_reqs):
+            sock.sendall(req)
+            buf = read_response(sock, buf)
+        done.wait()
+
+    threads = [
+        threading.Thread(target=client_loop, daemon=True)
+        for _ in range(n_conns)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    done.wait()
+    dt = time.perf_counter() - t0
+    return n_conns * n_reqs / dt
+
+
+def stage_http_ceiling(results):
+    from ray_tpu.serve.http_server import AioHttpServer
+
+    def handler(method, path, query, headers, body):
+        return 200, "application/json", b"ok"
+
+    server = AioHttpServer(handler, port=0, host="127.0.0.1")
+    results["A_http_executor_ceiling_req_s"] = round(
+        hammer_http("127.0.0.1", server.port), 1
+    )
+    results["B_http_ceiling_rawclient_req_s"] = round(
+        hammer_raw("127.0.0.1", server.port), 1
+    )
+    server.stop()
+
+
+def timed_us(fn, n=2000, warmup=50):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n * 1e6
+
+
+def main():
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core import cluster_utils, worker as worker_mod
+    from ray_tpu.serve.replica import Request
+    from ray_tpu.serve.router import Router
+
+    cluster_utils.sweep_stale_runtime()
+    results = {}
+    stage_http_ceiling(results)
+    print(json.dumps(results), flush=True)
+
+    ray_tpu.init(num_cpus=8)
+    serve.start(http_port=0)
+
+    @serve.deployment(num_replicas=2, max_concurrency=16,
+                      route_prefix="/noop")
+    class Noop:
+        def __call__(self, request):
+            return b"ok"
+
+    serve.run(Noop.bind())
+    deadline = time.monotonic() + 30
+    addrs = []
+    while time.monotonic() < deadline and not addrs:
+        addrs = serve.proxy_addresses()
+        time.sleep(0.2)
+    host, port = addrs[0].rsplit(":", 1)
+
+    controller = ray_tpu.get_actor("SERVE_CONTROLLER")
+    router = Router(controller)
+    req = Request("GET", "/noop", b"", {}, {})
+
+    def probe():
+        dep = router.deployment_for_route("/noop")
+        rid, _handle = router.choose_replica(dep)
+        router.request_finished(rid)
+
+    results["C_router_probe_us"] = round(timed_us(probe), 1)
+
+    # the direct hop, isolated (driver → replica worker and back)
+    w = worker_mod.global_worker()
+    dep = router.deployment_for_route("/noop")
+    rid, handle = router.choose_replica(dep)
+    router.request_finished(rid)
+    addr = w._resolve_actor_address(handle._actor_id, timeout_s=30)
+    client = w.workers.get(addr)
+
+    def direct_hop():
+        client.call("actor_direct_call", target="handle_request_direct",
+                    args=(req,), timeout_s=30)
+
+    results["D_direct_rpc_hop_us"] = round(timed_us(direct_hop), 1)
+
+    def direct_full():
+        router.call_direct("Noop", req, timeout_s=30)
+
+    results["D2_router_call_direct_us"] = round(timed_us(direct_full), 1)
+
+    def actor_task_path():
+        router.call("Noop", req, timeout_s=30)
+
+    results["E_actor_task_path_us"] = round(
+        timed_us(actor_task_path, n=1000), 1
+    )
+
+    # end-to-end through the proxy, both client harnesses
+    results["F_serve_http_noop_req_s"] = round(
+        hammer_http(host, int(port)), 1
+    )
+    results["F2_serve_http_noop_rawclient_req_s"] = round(
+        hammer_raw(host, int(port)), 1
+    )
+    results["serve_direct_rpc"] = bool(
+        __import__("ray_tpu.utils.config", fromlist=["config"])
+        .config.serve_direct_rpc
+    )
+    print(json.dumps(results, indent=2))
+    serve.delete("Noop")
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
