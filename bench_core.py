@@ -236,6 +236,95 @@ def main():
     record("serve_http_noop", serve_reqs, "req/s")
     record("serve_http_noop_rawclient", serve_reqs_raw, "req/s")
 
+    # -- host collectives: p2p ring allreduce ---------------------------
+    # 64 MiB x 8 ranks on the ring (head traffic measured — must be
+    # rendezvous-only), quantized-vs-f32 wire bytes, and an interleaved
+    # p2p-vs-KV A/B at 4 MiB x 4 ranks (64 MiB through the KV relay is
+    # O(world^2*payload) through one head process — benching it at full
+    # size would measure patience, not the head).
+    @ray_tpu.remote
+    class CollRank:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def setup(self, group, world):
+            from ray_tpu import collective
+
+            collective.init_collective_group(world, self.rank, "cpu", group)
+            return True
+
+        def set_flag(self, name, value):
+            from ray_tpu.utils.config import config
+
+            config.set(name, value)
+            return True
+
+        def reset_stats(self):
+            from ray_tpu.collective import p2p
+
+            return p2p.reset_stats()
+
+        def allreduce(self, group, n, quant=None):
+            from ray_tpu import collective
+
+            x = np.full(n, 1.0 + self.rank, dtype=np.float32)
+            collective.allreduce(x, group_name=group, quant=quant)
+            return True
+
+    def _kv_bytes():
+        s = w.control.call("kv_stats")
+        return s["bytes_put"] + s["bytes_out"]
+
+    def _ring_round(ranks, group, n, quant=None):
+        t0 = time.perf_counter()
+        ray_tpu.get([r.allreduce.remote(group, n, quant) for r in ranks],
+                    timeout=600)
+        return time.perf_counter() - t0
+
+    world = 8
+    ranks = [CollRank.remote(i) for i in range(world)]
+    ray_tpu.get([r.setup.remote("bench8", world) for r in ranks], timeout=120)
+    n64 = 16 * 1024 * 1024  # 16M f32 = 64 MiB per rank
+    _ring_round(ranks, "bench8", n64)  # warmup
+    kv0 = _kv_bytes()
+    lat = min(_ring_round(ranks, "bench8", n64) for _ in range(2))
+    head_bytes = _kv_bytes() - kv0
+    record("coll_allreduce_64mb_8rank_p2p", 64.0 / lat, "MiB/s")
+    record("coll_allreduce_64mb_8rank_head_kv_bytes", head_bytes, "bytes")
+
+    # wire-byte A/B: exactly ONE round on each side between stat resets
+    ray_tpu.get([r.reset_stats.remote() for r in ranks])
+    _ring_round(ranks, "bench8", n64)
+    f32_wire = sum(s["bytes_sent"]
+                   for s in ray_tpu.get([r.reset_stats.remote()
+                                         for r in ranks]))
+    q_lats = [_ring_round(ranks, "bench8", n64, quant="int8")]
+    q_wire = sum(s["bytes_sent"]
+                 for s in ray_tpu.get([r.reset_stats.remote()
+                                       for r in ranks]))
+    q_lats.append(_ring_round(ranks, "bench8", n64, quant="int8"))
+    q_lat = min(q_lats)
+    record("coll_allreduce_64mb_8rank_quant_int8", 64.0 / q_lat, "MiB/s")
+    record("coll_allreduce_quant_wire_reduction", f32_wire / q_wire, "x")
+
+    # interleaved same-day A/B: the SAME 4 ranks flip the kill switch
+    # per round, so box noise hits both sides equally
+    ab = ranks[:4]
+    ray_tpu.get([r.setup.remote("bench4", 4) for r in ab], timeout=120)
+    n4 = 1024 * 1024  # 4 MiB f32
+    _ring_round(ab, "bench4", n4)  # warmup
+    p2p_lats, kv_lats = [], []
+    for _ in range(3):
+        p2p_lats.append(_ring_round(ab, "bench4", n4))
+        ray_tpu.get([r.set_flag.remote("collective_p2p", False) for r in ab])
+        kv_lats.append(_ring_round(ab, "bench4", n4))
+        ray_tpu.get([r.set_flag.remote("collective_p2p", True) for r in ab])
+    record("coll_allreduce_4mb_4rank_p2p", 4.0 / min(p2p_lats), "MiB/s")
+    record("coll_allreduce_4mb_4rank_kv", 4.0 / min(kv_lats), "MiB/s")
+    record("coll_allreduce_p2p_vs_kv_speedup",
+           min(kv_lats) / min(p2p_lats), "x")
+    del ranks, ab
+
     # -- RDT device objects vs pickle path ------------------------------
     import jax
 
@@ -270,6 +359,25 @@ def main():
     per_s, dev_lat = timed(handoff_device, 20, warmup=3)
     record("actor_handoff_4mb_device", per_s, "handoffs/s")
     record("rdt_vs_pickle_speedup", pickle_lat / dev_lat, "x")
+
+    # the 64 MiB point (ROADMAP item 3: round-4 target ≥5x at 64 MiB,
+    # never measured until now)
+    n_rows_64 = 16 * 1024  # 16384 x 1024 f32 = 64 MiB
+
+    def handoff_pickle_64():
+        ref = p.make.remote(n_rows_64)
+        return ray_tpu.get(cns.total.remote(ref))
+
+    per_s, pickle_lat64 = timed(handoff_pickle_64, 6, warmup=1)
+    record("actor_handoff_64mb_pickle", per_s, "handoffs/s")
+
+    def handoff_device_64():
+        ref = p.make.options(tensor_transport="device").remote(n_rows_64)
+        return ray_tpu.get(cns.total.remote(ref))
+
+    per_s, dev_lat64 = timed(handoff_device_64, 6, warmup=1)
+    record("actor_handoff_64mb_device", per_s, "handoffs/s")
+    record("rdt_vs_pickle_speedup_64mb", pickle_lat64 / dev_lat64, "x")
 
     with open("BENCH_CORE.json", "w") as f:
         json.dump(results, f, indent=2)

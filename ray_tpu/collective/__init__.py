@@ -19,6 +19,7 @@ TPU-first backend mapping (SURVEY.md §2.4 "Collective backend"):
 """
 
 from ray_tpu.collective.collective import (
+    CollectiveError,
     ReduceOp,
     allgather,
     allreduce,
@@ -35,6 +36,7 @@ from ray_tpu.collective.collective import (
 from ray_tpu.collective.xla_group import get_xla_coordinator, xla_coordinator_env
 
 __all__ = [
+    "CollectiveError",
     "ReduceOp",
     "allgather",
     "allreduce",

@@ -118,6 +118,15 @@ class ControlStore:
         # pubsub: topic -> {conn_id: conn}
         self._subs: Dict[str, Dict[int, Any]] = {}
 
+        # Volatile KV traffic accounting (NOT durable state — survives
+        # nothing, counts everything): payload bytes written into and
+        # served out of the KV. The p2p collective tier's head-traffic
+        # guarantee ("rendezvous only, independent of payload size") is
+        # asserted against these counters (rpc_kv_stats).
+        self._kv_traffic = {
+            "puts": 0, "bytes_put": 0, "gets": 0, "bytes_out": 0,
+        }
+
         # aggregate resource-view version: bumps on any node join/leave or
         # resource change (versioned sync, reference ray_syncer.h:91)
         self._view_version = 0
@@ -687,13 +696,23 @@ class ControlStore:
         with self._lock:
             if not overwrite and key in self._kv.get(ns, {}):
                 return False
+            self._kv_traffic["puts"] += 1
+            self._kv_traffic["bytes_put"] += len(value) if value is not None else 0
             self._apply("kv_put", ns, key, value)
             self._kv_cv.notify_all()
             return True
 
+    def _kv_note_out(self, val) -> None:
+        """Count a KV value served to a client (volatile accounting)."""
+        if val is not None:
+            self._kv_traffic["gets"] += 1
+            self._kv_traffic["bytes_out"] += len(val)
+
     def rpc_kv_get(self, conn, ns: str, key: str):
         with self._lock:
-            return self._kv.get(ns, {}).get(key)
+            val = self._kv.get(ns, {}).get(key)
+            self._kv_note_out(val)
+            return val
 
     def rpc_kv_wait(self, conn, ns: str, key: str, wait_s: float = 60.0):
         """Block server-side until the key exists (or timeout); returns
@@ -705,11 +724,20 @@ class ControlStore:
             while True:
                 val = self._kv.get(ns, {}).get(key)
                 if val is not None:
+                    self._kv_note_out(val)
                     return val
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or self._stopped.is_set():
                     return None
                 self._kv_cv.wait(min(remaining, 1.0))
+
+    def rpc_kv_stats(self, conn):
+        """Volatile KV traffic counters: payload bytes in (kv_put) and
+        out (kv_get/kv_wait hits) since this head process started. Tests
+        pin the collective head-traffic guarantee against deltas of
+        these."""
+        with self._lock:
+            return dict(self._kv_traffic)
 
     def rpc_kv_del(self, conn, ns: str, key: str):
         with self._lock:

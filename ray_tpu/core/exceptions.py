@@ -63,3 +63,10 @@ class NodeDiedError(RayTpuError):
 
 class PlacementGroupError(RayTpuError):
     """Placement group creation/usage error."""
+
+
+class CollectiveError(RayTpuError):
+    """A host collective failed: a peer died, a ring transfer could not
+    be delivered, or the op deadline passed. Raised on every surviving
+    rank (the detecting rank poisons the ring so peers fail fast instead
+    of hanging)."""

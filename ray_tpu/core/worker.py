@@ -2870,6 +2870,22 @@ class CoreWorker:
             )
         return True
 
+    def rpc_coll_deliver(self, conn, group: str, token: str, tag: str,
+                         payload=None, poison: Optional[str] = None):
+        """Host-collective ring transport (collective/p2p.py): peer ranks
+        dial this worker DIRECTLY and deliver chunk payloads into the
+        target group's mailbox — the worker↔worker hop the p2p
+        collectives ride, with ndarray payloads arriving as raw
+        out-of-band multiseg segments (recv_into preallocated buffers),
+        never through the control store. Idempotent per (group
+        incarnation token, tag), so senders retry freely across
+        connection drops; a stale token (destroyed/re-initialized group)
+        drops the delivery. ``poison`` carries ring failure propagation
+        instead of a payload."""
+        from ray_tpu.collective import p2p
+
+        return p2p.deliver(group, token, tag, payload, poison=poison)
+
     def rpc_ping(self, conn):
         return {"worker_id": self.worker_id.hex(), "mode": self.mode,
                 "actor": self.current_actor_id()}

@@ -120,6 +120,19 @@ def _build() -> dict:
             "batch executes",
             boundaries=_LATENCY_BOUNDS,
         ),
+        # -- host collectives (collective/collective.py, collective/p2p.py) --
+        "collective_bytes_sent": Counter(
+            "rt_collective_bytes_sent_total",
+            "host-collective payload bytes sent by this process, by op "
+            "and transport (p2p ring deliveries vs control-store KV)",
+            tag_keys=("op", "transport"),
+        ),
+        "collective_op_latency_s": Histogram(
+            "rt_collective_op_latency_s",
+            "end-to-end host collective op latency by op",
+            boundaries=_LATENCY_BOUNDS,
+            tag_keys=("op",),
+        ),
         # -- task event buffer (worker.py) --
         "task_events_dropped": Counter(
             "rt_task_events_dropped_total",

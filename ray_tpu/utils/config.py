@@ -178,6 +178,22 @@ config.define("ha_reattach_max_s", 60.0)
 # empty = same-address restarts only.
 config.define("ha_head_address_file", "")
 config.define("lineage_max_bytes", 256 * 1024 * 1024)
+# Host collectives (collective/): peer-to-peer ring transport over the
+# worker<->worker multiseg RPC data plane. RT_COLLECTIVE_P2P=0 is the
+# kill switch — every collective byte rides the control-store KV again
+# (the pre-p2p path, and the A/B lever for bench_core).
+config.define("collective_p2p", True)
+# Payloads below this ride the KV path even with p2p on: a tiny tensor's
+# ring handshake costs more than one head round trip.
+config.define("collective_p2p_min_bytes", 32 * 1024)
+# Ring pipeline granularity: each ring chunk is split into subchunks of
+# about this many bytes so subchunk k+1 is on the wire while k reduces.
+config.define("collective_chunk_bytes", 1 * 1024 * 1024)
+# Deadline for one collective op (mailbox waits + delivery acks); a dead
+# peer surfaces as CollectiveError within this budget, never a hang.
+config.define("collective_op_timeout_s", 120.0)
+# Quantized allreduce (quant="int8"): elements per blockwise f32 scale.
+config.define("collective_quant_block", 2048)
 config.define("actor_max_restarts", 0)
 config.define("log_to_driver", True)
 config.define("temp_dir", "/tmp/ray_tpu")

@@ -90,6 +90,7 @@ _FAMILY_PREFIXES = (
     ("wait_placement_group", "pg"),
     ("remove_placement_group", "pg"),
     ("list_placement_groups", "pg"),
+    ("coll_deliver", "collective"),
     ("get_state", "state"),
     ("get_metrics", "state"),
     ("get_task_events", "state"),

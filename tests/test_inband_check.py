@@ -162,3 +162,27 @@ def test_nested_generator_returns_are_not_replies():
             return ("ok", None)
     """)
     assert not violations, violations
+
+
+def test_flags_packed_ring_chunk_send():
+    # collective transport shape: a ring chunk delivery must pass the
+    # ndarray itself, never a packed blob (which would re-pickle the
+    # whole chunk in-band)
+    violations = _check("""
+        def send_async(g, dst, tag, sub):
+            blob = serialization.pack(sub)
+            return client.call_async(
+                "coll_deliver", group=g.name, tag=tag, payload=blob
+            )
+    """)
+    assert len(violations) == 1 and "alias 'blob'" in violations[0]
+
+
+def test_ndarray_ring_chunk_send_is_clean():
+    violations = _check("""
+        def send_async(g, dst, tag, sub):
+            return client.call_async(
+                "coll_deliver", group=g.name, tag=tag, payload=sub
+            )
+    """)
+    assert not violations, violations

@@ -48,6 +48,12 @@ HOT_PATHS = (
     os.path.join("ray_tpu", "serve", "proxy.py"),
     os.path.join("ray_tpu", "serve", "replica.py"),
     os.path.join("ray_tpu", "serve", "router.py"),
+    # collective transport: ring chunk deliveries must pass ndarrays /
+    # Frame-wrapped values so they ride as out-of-band segments; only
+    # the KV fallback (which stores contiguous blobs by design) and the
+    # ~100 B rendezvous records may pack in-band (opted out per line)
+    os.path.join("ray_tpu", "collective", "p2p.py"),
+    os.path.join("ray_tpu", "collective", "collective.py"),
 )
 
 RPC_SEND_METHODS = {"call", "call_async", "call_oneway", "push",
