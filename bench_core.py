@@ -231,6 +231,63 @@ def main():
     finally:
         cdag.teardown()
 
+    # -- pipeline parallelism: RPC tier vs compiled channels ------------
+    # Same model, same microbatch count, interleaved same-day A/B: each
+    # round measures the RPC tier, then compiles the SAME stages and
+    # measures the channel tier, then tears down (the parked loops
+    # occupy the actors' executor slots, so the tiers can't overlap).
+    # Throughput = microbatch input bytes processed per second.
+    from ray_tpu.parallel.pipeline import Pipeline
+
+    rng = np.random.default_rng(0)
+    pp_W1 = rng.normal(size=(1024, 256)).astype(np.float32) * 0.05
+    pp_W2 = rng.normal(size=(256, 64)).astype(np.float32) * 0.05
+    pp_X = rng.normal(size=(512, 1024)).astype(np.float32)  # 2 MiB
+    pp_Y = rng.normal(size=(512, 64)).astype(np.float32)
+    pp_n_mb = 8
+    pp_mbs = list(np.split(pp_X, pp_n_mb))   # 256 KiB per microbatch
+    pp_tgts = list(np.split(pp_Y, pp_n_mb))
+    pp_mb_total = pp_X.nbytes / 2**20
+
+    def pp_stage1(params, x):
+        import jax.numpy as jnp
+
+        return jnp.tanh(x @ params["w"])
+
+    def pp_stage2(params, h):
+        return h @ params["w"]
+
+    def pp_loss(pred, target):
+        import jax.numpy as jnp
+
+        return jnp.mean((pred - target) ** 2)
+
+    pipe = Pipeline([pp_stage1, pp_stage2],
+                    [{"w": pp_W1}, {"w": pp_W2}], pp_loss)
+    pp_iters = 4
+    rpc_lats, comp_lats = [], []
+    for _ in range(3):
+        pipe.train_step(pp_mbs, pp_tgts)  # warmup / park params
+        t0 = time.perf_counter()
+        for _ in range(pp_iters):
+            pipe.train_step(pp_mbs, pp_tgts)
+        rpc_lats.append((time.perf_counter() - t0) / pp_iters)
+        cpipe = pipe.compile(schedule="1f1b", step_timeout_s=120.0)
+        try:
+            cpipe.train_step(pp_mbs, pp_tgts)
+            t0 = time.perf_counter()
+            for _ in range(pp_iters):
+                cpipe.train_step(pp_mbs, pp_tgts)
+            comp_lats.append((time.perf_counter() - t0) / pp_iters)
+        finally:
+            cpipe.teardown(timeout_s=30.0)
+    record("pipeline_rpc_mb_per_s", pp_mb_total / min(rpc_lats), "MiB/s")
+    record("pipeline_compiled_mb_per_s", pp_mb_total / min(comp_lats),
+           "MiB/s")
+    record("pipeline_compiled_vs_rpc_speedup",
+           min(rpc_lats) / min(comp_lats), "x")
+    pipe.shutdown()
+
     # -- serve HTTP data plane (asyncio proxy) --------------------------
     serve_reqs, serve_reqs_raw = _bench_serve_http()
     record("serve_http_noop", serve_reqs, "req/s")

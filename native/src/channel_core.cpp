@@ -4,16 +4,31 @@
 // (src/ray/core_worker/experimental_mutable_object_manager.cc with a
 // python/ray/experimental/channel wrapper); this is the ray_tpu
 // equivalent for ray_tpu/core/channels.py. Same shm layout as the
-// Python implementation ([seq u64][ack u64][len u64][payload]), so a
-// native writer interoperates with a Python reader and vice versa:
-// the Python tier is the FALLBACK, not a different protocol.
+// Python implementation, so a native writer interoperates with a
+// Python reader and vice versa: the Python tier is the FALLBACK, not a
+// different protocol.
+//
+// Ring layout (v2 — multi-slot so a compiled pipeline can stream
+// several rounds without a per-message rendezvous):
+//
+//   [seq u64][ack u64][nslots u64][slot_cap u64]       32-byte header
+//   slot i at 32 + i*(8+slot_cap): [len u64][payload]
+//
+// seq  = messages PUBLISHED (writer bumps after the payload is in);
+// ack  = messages CONSUMED (reader bumps after copying out).
+// Message k (0-based) lives in slot k % nslots. The writer blocks when
+// seq - ack == nslots (ring full); the reader blocks when seq == ack'
+// (nothing new past its cursor). nslots=1 reproduces the original
+// one-in-flight seqlock semantics exactly.
 //
 // What native buys over the Python path:
 //   - futex wake/wait on the header words (microsecond handoff between
 //     native peers) instead of select() on a FIFO doorbell; the FIFO is
 //     still rung so Python peers keep working.
 //   - release/acquire atomics on seq/ack instead of relying on the GIL.
-//   - no per-message Python bytecode on slicing/packing the header.
+//   - begin/commit entry points exposing the slot pointer, so Python
+//     can scatter-gather pickle-5 buffers STRAIGHT into shm (one copy,
+//     no join) while native does the waiting and the publishing.
 //
 // Build: g++ -O3 -shared -fPIC (ray_tpu/native/__init__.py builds on
 // demand and caches the .so; RT_NATIVE=0 disables).
@@ -36,14 +51,19 @@
 
 namespace {
 
-constexpr uint64_t kHdrSize = 24;  // seq u64 | ack u64 | len u64
+constexpr uint64_t kHdrSize = 32;  // seq u64 | ack u64 | nslots u64 | cap u64
 
 struct Chan {
   uint8_t* mm = nullptr;
-  uint64_t capacity = 0;
+  uint64_t slot_cap = 0;
+  uint64_t nslots = 1;
   int dbell = -1;  // data doorbell fifo (writer rings, reader drains)
   int abell = -1;  // ack doorbell fifo (reader rings, writer drains)
   uint64_t last_read = 0;
+  uint64_t total() const { return kHdrSize + nslots * (8 + slot_cap); }
+  uint8_t* slot(uint64_t msg) const {
+    return mm + kHdrSize + (msg % nslots) * (8 + slot_cap);
+  }
 };
 
 inline std::atomic<uint64_t>* word64(Chan* c, size_t off) {
@@ -117,12 +137,19 @@ bool wait_change(Chan* c, size_t off, uint64_t seen, double deadline,
 extern "C" {
 
 // Returns 0 on success. The fifo doorbells must already exist when
-// create=0 (the creator makes them).
-int rt_chan_open(const char* path, uint64_t capacity, int create,
-                 Chan** out) {
+// create=0 (the creator makes them). An attach whose (nslots, slot_cap)
+// disagree with the creator's header returns -EPROTO: the geometry is
+// part of the handle contract, and a silent mismatch would alias slots.
+int rt_chan_open(const char* path, uint64_t slot_cap, uint64_t nslots,
+                 int create, Chan** out) {
+  // slot stride is 8+slot_cap and each slot leads with an atomic u64
+  // length word: an unaligned slot_cap would make every odd slot's
+  // length access UB (the Python wrapper rounds up before calling)
+  if (nslots == 0 || slot_cap == 0 || (slot_cap & 7) != 0) return -EINVAL;
   Chan* c = new Chan();
-  c->capacity = capacity;
-  uint64_t total = kHdrSize + capacity;
+  c->slot_cap = slot_cap;
+  c->nslots = nslots;
+  uint64_t total = c->total();
   int flags = O_RDWR | (create ? O_CREAT : 0);
   int fd = open(path, flags, 0600);
   if (fd < 0) { delete c; return -errno; }
@@ -135,6 +162,8 @@ int rt_chan_open(const char* path, uint64_t capacity, int create,
   c->mm = static_cast<uint8_t*>(mm);
   if (create) {
     memset(c->mm, 0, kHdrSize);
+    word64(c, 16)->store(nslots, std::memory_order_relaxed);
+    word64(c, 24)->store(slot_cap, std::memory_order_release);
     char p2[4096];
     for (const char* suffix : {".d", ".a"}) {
       snprintf(p2, sizeof p2, "%s%s", path, suffix);
@@ -142,6 +171,9 @@ int rt_chan_open(const char* path, uint64_t capacity, int create,
         munmap(c->mm, total); delete c; return -errno;
       }
     }
+  } else if (word64(c, 16)->load(std::memory_order_acquire) != nslots ||
+             word64(c, 24)->load(std::memory_order_acquire) != slot_cap) {
+    munmap(c->mm, total); delete c; return -EPROTO;
   }
   char p2[4096];
   snprintf(p2, sizeof p2, "%s.d", path);
@@ -161,46 +193,87 @@ int rt_chan_open(const char* path, uint64_t capacity, int create,
   return 0;
 }
 
-// 0 ok, -1 timeout, -2 payload too large.
-int rt_chan_write(Chan* c, const uint8_t* buf, uint64_t len,
-                  double timeout_s) {
-  if (len > c->capacity) return -2;
+// Reserve the next slot for a payload of `len` bytes; blocks while the
+// ring is full. On success *slot_out points at the slot's payload area
+// (the caller copies in, then calls rt_chan_write_commit). 0 ok,
+// -1 timeout, -2 payload too large.
+int rt_chan_write_begin(Chan* c, uint64_t len, double timeout_s,
+                        uint8_t** slot_out) {
+  if (len > c->slot_cap) return -2;
   double deadline = timeout_s < 0 ? 0 : now_s() + timeout_s;
   uint64_t seq = word64(c, 0)->load(std::memory_order_acquire);
-  // flow control: the previous message must have been consumed
-  if (word64(c, 8)->load(std::memory_order_acquire) < seq) {
-    if (!wait_change(c, 8, seq - 1, deadline, c->abell)) return -1;
-    // ack advanced; it can only ever advance to seq
+  // flow control: block while every slot holds an unconsumed message
+  while (true) {
+    uint64_t ack = word64(c, 8)->load(std::memory_order_acquire);
+    if (seq - ack < c->nslots) break;
+    if (!wait_change(c, 8, ack, deadline, c->abell)) return -1;
   }
-  memcpy(c->mm + kHdrSize, buf, len);
-  word64(c, 16)->store(len, std::memory_order_relaxed);
+  *slot_out = c->slot(seq) + 8;
+  return 0;
+}
+
+// Publish the slot reserved by rt_chan_write_begin.
+int rt_chan_write_commit(Chan* c, uint64_t len) {
+  uint64_t seq = word64(c, 0)->load(std::memory_order_acquire);
+  reinterpret_cast<std::atomic<uint64_t>*>(c->slot(seq))
+      ->store(len, std::memory_order_relaxed);
   word64(c, 0)->store(seq + 1, std::memory_order_release);
   futex_wake_all(word32(c, 0));
   ring(c->dbell);
   return 0;
 }
 
-// >= 0: payload length (copied into buf). -1 timeout, -3 buf too small.
-int64_t rt_chan_read(Chan* c, uint8_t* buf, uint64_t buflen,
-                     double timeout_s) {
+// 0 ok, -1 timeout, -2 payload too large.
+int rt_chan_write(Chan* c, const uint8_t* buf, uint64_t len,
+                  double timeout_s) {
+  uint8_t* slot;
+  int rc = rt_chan_write_begin(c, len, timeout_s, &slot);
+  if (rc != 0) return rc;
+  memcpy(slot, buf, len);
+  return rt_chan_write_commit(c, len);
+}
+
+// Wait for the next unconsumed message; on success *payload_out points
+// at its bytes in shm and the length is returned. The slot stays owned
+// by the reader until rt_chan_read_commit (the writer cannot overwrite
+// it: ack has not advanced). >= 0: payload length. -1 timeout.
+int64_t rt_chan_read_begin(Chan* c, double timeout_s,
+                           uint8_t** payload_out) {
   double deadline = timeout_s < 0 ? 0 : now_s() + timeout_s;
   if (word64(c, 0)->load(std::memory_order_acquire) == c->last_read) {
     if (!wait_change(c, 0, c->last_read, deadline, c->dbell)) return -1;
   }
-  uint64_t seq = word64(c, 0)->load(std::memory_order_acquire);
-  uint64_t len = word64(c, 16)->load(std::memory_order_relaxed);
-  if (len > buflen) return -3;
-  memcpy(buf, c->mm + kHdrSize, len);
-  c->last_read = seq;
-  word64(c, 8)->store(seq, std::memory_order_release);
+  uint8_t* slot = c->slot(c->last_read);
+  uint64_t len = reinterpret_cast<std::atomic<uint64_t>*>(slot)
+      ->load(std::memory_order_relaxed);
+  *payload_out = slot + 8;
+  return int64_t(len);
+}
+
+// Release the slot returned by rt_chan_read_begin back to the writer.
+int rt_chan_read_commit(Chan* c) {
+  c->last_read += 1;
+  word64(c, 8)->store(c->last_read, std::memory_order_release);
   futex_wake_all(word32(c, 8));
   ring(c->abell);
-  return int64_t(len);
+  return 0;
+}
+
+// >= 0: payload length (copied into buf). -1 timeout, -3 buf too small.
+int64_t rt_chan_read(Chan* c, uint8_t* buf, uint64_t buflen,
+                     double timeout_s) {
+  uint8_t* payload;
+  int64_t len = rt_chan_read_begin(c, timeout_s, &payload);
+  if (len < 0) return len;
+  if (uint64_t(len) > buflen) return -3;
+  memcpy(buf, payload, size_t(len));
+  rt_chan_read_commit(c);
+  return len;
 }
 
 void rt_chan_close(Chan* c) {
   if (c == nullptr) return;
-  if (c->mm != nullptr) munmap(c->mm, kHdrSize + c->capacity);
+  if (c->mm != nullptr) munmap(c->mm, c->total());
   if (c->dbell >= 0) close(c->dbell);
   if (c->abell >= 0) close(c->abell);
   delete c;

@@ -41,10 +41,12 @@ construction in the multi-controller model).
 
 from __future__ import annotations
 
+import queue
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.utils import serialization
+from ray_tpu.utils.config import config
 
 VALID_TRANSPORTS = ("object", "device")
 
@@ -211,5 +213,119 @@ class DeviceObjectStore:
             objs = list(self._objects.values())
         total = sum(_meta_nbytes(leaves_meta) for _, _, leaves_meta in objs)
         return {"device_objects": len(objs), "device_bytes": total}
+
+
+# ---------------------------------------------------------------------------
+# Overlapped export: double-buffered chunked D2H -> shm/socket write
+# ---------------------------------------------------------------------------
+#
+# The export path (worker._build_device_export) used to be strictly
+# serial: D2H-convert EVERY leaf, then pwrite every byte. Here the two
+# halves pipeline through a depth-2 staging queue (the double buffer):
+# a producer thread issues ``copy_to_host_async`` for leaf i+1 before
+# materializing leaf i and emits (offset, host-view) chunks of
+# ``rdt_d2h_chunk_bytes``; the caller thread pwrites chunk k-1 while
+# chunk k's device->host copy is in flight — the nixl_tensor_transport
+# playbook of hiding the transfer behind the write (and vice versa).
+# On a TPU the D2H is a real DMA and the overlap is wall-clock; on the
+# CPU backend np.asarray is a zero-copy view, so the win there comes
+# from the producer-side EAGER export instead (worker._package_returns
+# kicks this machinery the moment a device return is parked, so the
+# whole export overlaps the consumer task's submit/schedule latency
+# rather than sitting on its first-get critical path).
+
+
+def plan_export_layout(arrays: List[Any]) -> Tuple[List[int], int]:
+    """64B-aligned segment offsets for each leaf (from aval nbytes — no
+    materialization) and the total segment size."""
+    offsets: List[int] = []
+    off = 0
+    for a in arrays:
+        off = (off + 63) & ~63  # 64B-align each leaf for frombuffer
+        offsets.append(off)
+        off += a.nbytes
+    return offsets, max(off, 1)
+
+
+def _stage_chunks(arrays, offsets, chunk_bytes, emit) -> None:
+    """D2H-convert each leaf (async-prefetching the next) and emit
+    (file_offset, host_byte_view) pieces of at most ``chunk_bytes``."""
+    import numpy as np
+
+    for i, a in enumerate(arrays):
+        if i + 1 < len(arrays):
+            nxt = arrays[i + 1]
+            if hasattr(nxt, "copy_to_host_async"):
+                try:
+                    nxt.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — optional fast path
+                    pass
+        host = np.ascontiguousarray(np.asarray(a))
+        mv = memoryview(host).cast("B")
+        base = offsets[i]
+        for lo in range(0, mv.nbytes, chunk_bytes):
+            emit((base + lo, mv[lo: lo + chunk_bytes]))
+
+
+def write_arrays_overlapped(fd: int, arrays: List[Any],
+                            offsets: List[int]) -> None:
+    """Write every leaf's bytes at its offset, overlapping the D2H of
+    chunk k with the pwrite of chunk k-1 through a depth-2 queue.
+    Falls back to the serial convert-then-write loop when
+    ``rdt_d2h_overlap`` is off (or there is nothing to overlap)."""
+    from ray_tpu.core.object_store import _pwrite_all
+
+    chunk_bytes = max(64 * 1024, int(config.rdt_d2h_chunk_bytes))
+    if arrays and hasattr(arrays[0], "copy_to_host_async"):
+        try:
+            arrays[0].copy_to_host_async()
+        except Exception:  # noqa: BLE001 — optional fast path
+            pass
+    if not config.rdt_d2h_overlap or not arrays:
+        _stage_chunks(arrays, offsets, chunk_bytes,
+                      lambda item: _pwrite_all(fd, item[1], item[0]))
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=2)  # the double buffer
+    stop = threading.Event()
+
+    def _emit(item):
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+        raise RuntimeError("export cancelled")  # consumer bailed
+
+    def _produce():
+        try:
+            _stage_chunks(arrays, offsets, chunk_bytes, _emit)
+            _emit(None)
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            try:
+                _emit(e)
+            except RuntimeError:
+                pass
+
+    t = threading.Thread(target=_produce, daemon=True,
+                         name="rt-rdt-d2h")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            off, view = item
+            _pwrite_all(fd, view, off)
+    finally:
+        stop.set()
+        while True:  # unblock a producer parked on a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=10.0)
 
 

@@ -48,7 +48,6 @@ from ray_tpu.core.object_store import (
     MemoryStore,
     PlasmaValue,
     ShmClient,
-    _pwrite_all,
     pwritev_all,
 )
 from ray_tpu.core.task import TaskOptions, TaskSpec
@@ -437,6 +436,10 @@ class CoreWorker:
         # over the sendfile data plane (cross host)
         self._device_exports: Dict[str, Dict[str, Any]] = {}
         self._device_exports_lock = threading.Lock()
+        # eager-export throttle: at most this many background D2H+write
+        # threads at once; past it, exports stay lazy (consumer's first
+        # get builds them) instead of queueing unbounded work
+        self._eager_export_sem = threading.BoundedSemaphore(2)
         # remote-driver (gateway) mode: set by enable_gateway_mode()
         self._public_address: Optional[str] = None
         self._remote_driver = False
@@ -685,6 +688,7 @@ class CoreWorker:
             parts = self.device_store.put(oid.hex(), value)
             if parts is not None:
                 skeleton, leaves_meta = parts
+                self._maybe_eager_export(oid.hex())
                 self.memory_store.put(
                     oid,
                     DeviceValue(self.address, oid.hex(), skeleton, leaves_meta),
@@ -2464,6 +2468,16 @@ class CoreWorker:
                     target = functools.partial(
                         dag_mod._actor_exec_loop, rt.instance
                     )
+                elif spec.method_name == "__rt_pipe_exec_loop__":
+                    # compiled-pipeline stage loop (parallel/pipeline.py):
+                    # parks on this stage actor until pipeline teardown
+                    import functools
+
+                    from ray_tpu.parallel import pipeline as pipeline_mod
+
+                    target = functools.partial(
+                        pipeline_mod._stage_exec_loop, rt.instance
+                    )
                 else:
                     target = getattr(rt.instance, spec.method_name, None)
                 if target is None:
@@ -2578,6 +2592,7 @@ class CoreWorker:
                 parts = self.device_store.put(oid.hex(), value)
                 if parts is not None:
                     skeleton, leaves_meta = parts
+                    self._maybe_eager_export(oid.hex())
                     returns.append((
                         oid.hex(),
                         ("device", (self.address, skeleton, leaves_meta)),
@@ -2695,6 +2710,35 @@ class CoreWorker:
         self.delete_owned_object(ObjectID.from_hex(oid_hex))
         return True
 
+    def _maybe_eager_export(self, obj_hex: str) -> None:
+        """Kick the shm export in the background the moment a device
+        value is parked (task return / put): the D2H + segment write
+        overlaps the consumer task's submit/schedule latency instead of
+        sitting on its first-get critical path — the producer-side half
+        of hiding transfer behind execution (arxiv 1909.09756). The
+        export is single-flight and cached, so the consumer's
+        ``export_device_object`` RPC finds it done (or joins it
+        mid-flight); a value freed before any consumer reads it deletes
+        the eager segment through the normal free path. RT_RDT_EAGER_
+        EXPORT=0 restores lazy first-get exports (saves the wasted work
+        when consumers are usually in-process)."""
+        if not config.rdt_eager_export:
+            return
+        if not self._eager_export_sem.acquire(blocking=False):
+            return  # throttled: this object exports lazily on first get
+
+        def _run():
+            try:
+                self._export_device_segment(obj_hex)
+            except Exception:  # noqa: BLE001 — consumer path will retry
+                pass
+            finally:
+                self._eager_export_sem.release()
+
+        threading.Thread(
+            target=_run, daemon=True, name="rt-rdt-eager-export"
+        ).start()
+
     def rpc_export_device_object(self, conn, obj_hex: str):
         """Export a device object's leaf buffers ONCE into a shm segment
         hosted by this node's agent, and hand consumers (path, size,
@@ -2750,24 +2794,12 @@ class CoreWorker:
             inflight.set()
 
     def _build_device_export(self, obj_hex: str) -> Dict[str, Any]:
-        import numpy as np
+        from ray_tpu.core import device_objects as dev_mod
 
         arrays = self.device_store.arrays(obj_hex)
-        # overlap the device->host DMAs before touching any bytes
-        for a in arrays:
-            if hasattr(a, "copy_to_host_async"):
-                try:
-                    a.copy_to_host_async()
-                except Exception:  # noqa: BLE001 — optional fast path
-                    pass
-        bufs = [np.ascontiguousarray(np.asarray(a)) for a in arrays]
-        offsets = []
-        off = 0
-        for b in bufs:
-            off = (off + 63) & ~63  # 64B-align each leaf for frombuffer
-            offsets.append(off)
-            off += b.nbytes
-        total = max(off, 1)
+        # layout from avals only — nothing materializes until the
+        # overlapped writer stages it chunk by chunk
+        offsets, total = dev_mod.plan_export_layout(arrays)
         try:
             path = self.agent.call(
                 "create_object", oid_hex=obj_hex, size=total
@@ -2780,11 +2812,12 @@ class CoreWorker:
             )
         # pwrite, not mmap: writing fresh tmpfs pages through a
         # mapping pays a page-fault per 4K page (~3x slower than the
-        # kernel's bulk allocate+copy in write(2))
+        # kernel's bulk allocate+copy in write(2)). The writer double-
+        # buffers: D2H of chunk k overlaps the pwrite of chunk k-1
+        # (device_objects.write_arrays_overlapped).
         fd = os.open(path, os.O_RDWR)
         try:
-            for b, o in zip(bufs, offsets):
-                _pwrite_all(fd, memoryview(b).cast("B"), o)
+            dev_mod.write_arrays_overlapped(fd, arrays, offsets)
         finally:
             os.close(fd)
         # oneway: consumers read the bytes by path, not through the
@@ -2885,6 +2918,19 @@ class CoreWorker:
         from ray_tpu.collective import p2p
 
         return p2p.deliver(group, token, tag, payload, poison=poison)
+
+    def rpc_chan_push(self, conn, chan_id: str, seq: int, payload,
+                      slots: int = 1):
+        """Cross-host channel delivery (core/channels.py RpcChannel):
+        the compiled-pipeline stage-boundary hop for stages that do not
+        share a host. The payload arrives Frame-wrapped when ≥ the
+        multiseg floor — raw out-of-band segments on the wire, never an
+        in-band re-pickle. Idempotent per (chan_id, seq); a full mailbox
+        bounces with ``full`` (the writer's retry loop is the
+        backpressure)."""
+        from ray_tpu.core import channels as channels_mod
+
+        return channels_mod.rpc_channel_deliver(chan_id, seq, payload, slots)
 
     def rpc_ping(self, conn):
         return {"worker_id": self.worker_id.hex(), "mode": self.mode,

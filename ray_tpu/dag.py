@@ -11,8 +11,10 @@ amortize all of it away. Compiling a DAG:
 
 - allocates one :class:`ray_tpu.core.channels.ShmChannel` per
   cross-process edge (driver→actor, actor→actor, actor→driver) — a
-  mutable shm segment reused every call (one mmap, then memcpy + seqlock
-  flip per message);
+  mutable shm RING of ``channel_slots`` message slots reused every call
+  (one mmap, then one scatter-gather copy + seqlock flip per message),
+  so exec loops stream up to ``channel_slots`` rounds ahead of their
+  consumers;
 - parks a persistent exec loop on every participating actor (a system
   actor task, ``__rt_dag_exec_loop__``): each round it reads its input
   channels, runs its bound methods in topological order, and writes
@@ -36,14 +38,36 @@ tier; the compiled loop occupies one executor slot on each actor until
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.channels import ShmChannel
 from ray_tpu.utils import serialization
 
+logger = logging.getLogger(__name__)
+
 _STOP = b"__rt_dag_stop__"
 _node_counter = itertools.count()
+
+
+def _is_stop(frame) -> bool:
+    """A raw channel frame is the teardown sentinel (RpcChannel reads
+    can surface Frame-wrapped payloads; the sentinel is tiny and always
+    arrives as plain bytes)."""
+    return isinstance(frame, (bytes, bytearray)) and frame == _STOP
+
+
+def send_value(channels, value: Any,
+               timeout_s: Optional[float] = 60.0) -> None:
+    """Serialize once, scatter-gather the frame into every channel —
+    pickle-5 out-of-band buffers are copied straight into each shm slot
+    (or ride as multiseg segments on an RpcChannel), never joined into
+    an intermediate in-band blob."""
+    meta, views = serialization.serialize(value)
+    parts = serialization.frame_parts(meta, views)
+    for ch in channels:
+        ch.write_views(parts, timeout_s=timeout_s)
 
 
 class DAGNode:
@@ -51,9 +75,28 @@ class DAGNode:
         self._id = next(_node_counter)
 
     def experimental_compile(
-        self, channel_capacity: int = 4 * 1024 * 1024
+        self,
+        channel_capacity: int = 4 * 1024 * 1024,
+        max_inflight: int = 2,
+        channel_slots: Optional[int] = None,
     ) -> "CompiledDAG":
-        return CompiledDAG(self, channel_capacity)
+        """Compile the static graph: allocate channels, park exec loops.
+
+        Backpressure contract: at most ``max_inflight`` ``execute()``
+        rounds may be unconsumed (``get()`` not yet called) — the next
+        ``execute()`` past that raises instead of blocking (parity:
+        ``RayCgraphCapacityExceeded``). Every channel is a ring of
+        ``channel_slots`` message slots (default: ``max_inflight``), so
+        exec loops stream that many rounds ahead before a write blocks
+        on its consumer; with the default sizing the driver-side
+        ``max_inflight`` check always trips BEFORE an input ring can
+        fill, so ``execute()`` never blocks inside its lock. Passing
+        ``channel_slots < max_inflight`` is allowed but re-introduces
+        writer-side blocking once the smaller ring fills. Each slot
+        holds one message of up to ``channel_capacity`` bytes.
+        """
+        return CompiledDAG(self, channel_capacity, max_inflight,
+                           channel_slots)
 
 
 class InputNode(DAGNode):
@@ -137,18 +180,29 @@ class CompiledDAG:
     """The compiled form: channels allocated, exec loops parked."""
 
     def __init__(self, root: DAGNode, channel_capacity: int,
-                 max_inflight: int = 2):
+                 max_inflight: int = 2,
+                 channel_slots: Optional[int] = None):
         from ray_tpu.core import worker as worker_mod
 
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if channel_slots is None:
+            channel_slots = max_inflight
+        if channel_slots < 1:
+            raise ValueError(
+                f"channel_slots must be >= 1, got {channel_slots}"
+            )
         self._w = worker_mod.global_worker()
         self._capacity = channel_capacity
+        self._slots = channel_slots
         self._lock = threading.Lock()
         self._exec_seq = 0
         self._read_seq = 0
-        # FIFO backpressure bound: each channel holds ONE in-flight
-        # message, so unconsumed rounds beyond this would block execute()
-        # inside the lock (reference raises RayCgraphCapacityExceeded for
-        # the same reason) — surface a clear error instead.
+        # FIFO backpressure bound: each channel rings channel_slots
+        # messages, so unconsumed rounds beyond max_inflight would block
+        # execute() inside the lock once the ring fills (reference raises
+        # RayCgraphCapacityExceeded for the same reason) — surface a
+        # clear error instead.
         self._max_inflight = max_inflight
         self._torn_down = False
         self._broken = False
@@ -198,7 +252,7 @@ class CompiledDAG:
             key = (producer_id, consumer_aid)
             ch = chan_for.get(key)
             if ch is None:
-                ch = ShmChannel.create(self._capacity)
+                ch = ShmChannel.create(self._capacity, slots=self._slots)
                 chan_for[key] = ch
                 plans[consumer_aid]["in"][producer_id] = ch.handle()
                 if producer_id == -1:
@@ -233,11 +287,18 @@ class CompiledDAG:
             })
 
         for out in self._outputs:
-            ch = ShmChannel.create(self._capacity)
+            ch = ShmChannel.create(self._capacity, slots=self._slots)
             self._output_channels.append(ch)
             plans[node_actor[out._id]]["out"].setdefault(
                 str(out._id), []
             ).append(ch.handle())
+
+        # the driver owns EVERY channel's shm lifetime (actor→actor edges
+        # included): teardown unlinks them all, so a wedged exec loop
+        # cannot strand /dev/shm/rtchan_* debris for sweep_stale_runtime
+        self._edge_channels = [
+            ch for (pid, _), ch in chan_for.items() if pid >= 0
+        ]
 
         # park the exec loops (their replies arrive at teardown)
         self._loop_refs = []
@@ -266,10 +327,13 @@ class CompiledDAG:
                     f"{self._max_inflight}); get() earlier results first"
                 )
             if self._input is not None:
-                payload = serialization.pack(args[0] if len(args) == 1 else args)
+                meta, views = serialization.serialize(
+                    args[0] if len(args) == 1 else args
+                )
+                parts = serialization.frame_parts(meta, views)
                 for i, ch in enumerate(self._input_channels):
                     try:
-                        ch.write(payload)
+                        ch.write_views(parts)
                     except Exception:
                         if i > 0:
                             # earlier channels already hold this round's
@@ -303,7 +367,7 @@ class CompiledDAG:
                         # leaves would pair across rounds — poison the DAG
                         self._broken = True
                     raise
-                if frame == _STOP:
+                if _is_stop(frame):
                     raise RuntimeError("compiled DAG torn down mid-read")
                 outs.append(serialization.unpack(frame))
             self._read_seq = seq
@@ -312,7 +376,7 @@ class CompiledDAG:
                 raise o
         return outs if self._multi else outs[0]
 
-    def teardown(self) -> None:
+    def teardown(self, timeout_s: float = 60.0) -> None:
         import time as _time
 
         with self._lock:
@@ -327,7 +391,7 @@ class CompiledDAG:
 
         pending = list(self._loop_refs)
         stop_sent = [False] * len(self._input_channels)
-        deadline = _time.monotonic() + 60.0
+        deadline = _time.monotonic() + timeout_s
         while pending and _time.monotonic() < deadline:
             for i, ch in enumerate(self._input_channels):
                 if not stop_sent[i]:
@@ -346,8 +410,26 @@ class CompiledDAG:
                     pending, num_returns=len(pending), timeout=0.3
                 )
             except Exception:  # noqa: BLE001 — actor may already be dead
+                pending = []
                 break
-        for ch in self._input_channels + self._output_channels:
+        if pending:
+            # a wedged exec loop (stage blocked in user code, actor
+            # half-dead) outlived the drain deadline: say so loudly —
+            # the channels are unlinked below regardless, so no
+            # /dev/shm/rtchan_* debris survives for sweep_stale_runtime,
+            # but the actor's executor slot stays occupied until the
+            # loop dies with its process.
+            logger.warning(
+                "compiled DAG teardown: %d exec loop(s) still running "
+                "after the %.0fs drain deadline; unlinking all %d "
+                "channel(s) anyway (wedged loops keep their actors' "
+                "executor slots until the actor dies)",
+                len(pending), timeout_s,
+                len(self._input_channels) + len(self._output_channels)
+                + len(self._edge_channels),
+            )
+        for ch in (self._input_channels + self._output_channels
+                   + self._edge_channels):
             ch.close(unlink=True)
 
 
@@ -375,7 +457,7 @@ def _actor_exec_loop(instance, plan_blob: bytes) -> int:
             if pid in cache:
                 return cache[pid]
             frame = in_ch[pid].read(timeout_s=None)
-            if frame == _STOP:
+            if _is_stop(frame):
                 stopping = True
                 return None
             value = serialization.unpack(frame)
@@ -408,8 +490,8 @@ def _actor_exec_loop(instance, plan_blob: bytes) -> int:
                 except Exception as e:  # noqa: BLE001 — ship to consumer
                     result = e
             produced[step["node_id"]] = result
-            for ch in out_ch.get(str(step["node_id"]), ()):
-                ch.write(serialization.pack(result), timeout_s=None)
+            send_value(out_ch.get(str(step["node_id"]), ()), result,
+                       timeout_s=None)
         rounds += 1
     for ch in list(in_ch.values()):
         ch.close()

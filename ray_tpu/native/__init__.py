@@ -107,8 +107,8 @@ def channel_lib() -> ctypes.CDLL | None:
         return None
     if not getattr(lib, "_rt_sigs_set", False):
         lib.rt_chan_open.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
         ]
         lib.rt_chan_open.restype = ctypes.c_int
         lib.rt_chan_write.argtypes = [
@@ -116,11 +116,27 @@ def channel_lib() -> ctypes.CDLL | None:
             ctypes.c_double,
         ]
         lib.rt_chan_write.restype = ctypes.c_int
+        lib.rt_chan_write_begin.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.rt_chan_write_begin.restype = ctypes.c_int
+        lib.rt_chan_write_commit.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.rt_chan_write_commit.restype = ctypes.c_int
         lib.rt_chan_read.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
             ctypes.c_double,
         ]
         lib.rt_chan_read.restype = ctypes.c_int64
+        lib.rt_chan_read_begin.argtypes = [
+            ctypes.c_void_p, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.rt_chan_read_begin.restype = ctypes.c_int64
+        lib.rt_chan_read_commit.argtypes = [ctypes.c_void_p]
+        lib.rt_chan_read_commit.restype = ctypes.c_int
         lib.rt_chan_close.argtypes = [ctypes.c_void_p]
         lib.rt_chan_close.restype = None
         lib._rt_sigs_set = True

@@ -1,30 +1,57 @@
-"""Pipeline parallelism — stage-per-actor GPipe microbatching.
+"""Pipeline parallelism — stage-per-actor microbatching, RPC or compiled.
 
-Parity: the role Compiled Graphs play for PP in the reference
-(python/ray/dag/compiled_dag_node.py:805 — static actor DAGs with
-pre-allocated channels driving microbatch loops). Here each pipeline
-stage is an actor holding its stage's parameters; the driver submits the
+Two execution tiers over the same :class:`PipelineStage` actors:
+
+**RPC tier** (:class:`Pipeline`, the original): the driver submits the
 microbatch forward chain and the reverse backward chain as ordered actor
-calls, so the per-actor FIFO queues yield the GPipe overlap (stage 1
-computes microbatch k+1's forward while stage 2 works on k) without any
-per-step scheduling — activations flow stage-to-stage as ObjectRefs
-through the shm object plane (same-host consumers read them zero-copy;
-ray_tpu.core.channels.ShmChannel is the mutable-channel primitive for
-the µs-latency tier).
+calls; per-actor FIFO queues yield the GPipe overlap, activations flow
+as ObjectRefs through the shm object plane. Every microbatch hop pays
+the full submit→lease→push→reply RPC path (~1 ms class).
 
-Training semantics: classic GPipe. forward saves each microbatch's VJP;
-backward pops it, accumulates parameter grads; apply() runs the
-optimizer on the accumulated grads and clears them. Gradients are
-mathematically identical to the unpipelined model (microbatch gradient
-averaging), which the tests assert.
+**Compiled tier** (:class:`CompiledPipeline`, via ``Pipeline.compile``):
+the stage graph is compiled ONCE — a persistent exec loop parks on each
+stage actor (``__rt_pipe_exec_loop__``, like dag.py's compiled-graph
+loops) and all microbatch traffic rides native seqlock ring channels
+(ray_tpu.core.channels.ShmChannel): one memcpy + atomic flip per
+message, no scheduler, no lease, no RPC framing. Cross-host stage
+boundaries ride :class:`~ray_tpu.core.channels.RpcChannel` instead —
+one worker↔worker RPC per activation, ≥32 KiB payloads as raw
+out-of-band multiseg segments. This is the workload compiled graphs
+exist for (parity: python/ray/dag/compiled_dag_node.py:805 driving PP
+microbatch loops; "Exploring the limits of Concurrency in ML Training
+on Google TPUs", arxiv 2011.03641 — remove per-step host scheduling,
+overlap transfer with compute).
+
+Schedules (compiled tier):
+
+- ``"gpipe"``: every stage runs all n forwards, then all n backwards.
+  Peak saved activations per stage: O(n_microbatches).
+- ``"1f1b"``: stage i runs ``min(n, S-1-i)`` warmup forwards, then
+  alternates one-forward-one-backward to the steady state, then drains
+  the remaining backwards. Peak saved activations per stage:
+  O(min(n, S - i)) — the classic PipeDream-flush/1F1B memory win.
+  Backwards run in the same microbatch order as GPipe at every stage,
+  so accumulated gradients are BIT-IDENTICAL between the two schedules
+  (pinned by tests).
+
+Training semantics: forward saves each microbatch's VJP; backward pops
+it, accumulates parameter grads; apply() runs the optimizer on the
+accumulated (averaged) grads and clears them. Gradients are
+mathematically identical to the unpipelined model, which the tests
+assert.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import ray_tpu
 from ray_tpu.utils import serialization
+from ray_tpu.utils.config import config
+
+logger = logging.getLogger(__name__)
 
 
 @ray_tpu.remote
@@ -115,6 +142,30 @@ class PipelineStage:
     def get_params(self):
         return self.params
 
+    def reset_step(self):
+        """Drop saved VJPs and partial grad accumulation (a failed
+        compiled step leaves the stage mid-flight; the next step must
+        start clean)."""
+        self._vjps.clear()
+        self._grad_acc = None
+        self._n_acc = 0
+        return True
+
+    def transport_info(self):
+        """Where this stage's process lives — the compiled tier places
+        ShmChannel on same-node stage edges and RpcChannel on
+        cross-node ones."""
+        from ray_tpu.core import worker as worker_mod
+
+        w = worker_mod.global_worker()
+        return {"node_id": w.node_id_hex, "address": w.address}
+
+    def pid(self):
+        """This stage's worker process id (chaos tests SIGKILL it)."""
+        import os
+
+        return os.getpid()
+
 
 class Pipeline:
     """Driver-side GPipe coordinator over PipelineStage actors."""
@@ -181,9 +232,507 @@ class Pipeline:
     def get_params(self) -> List[Any]:
         return ray_tpu.get([s.get_params.remote() for s in self.stages])
 
+    def compile(self, **kwargs) -> "CompiledPipeline":
+        """Compile the stage graph once: park exec loops, stream every
+        microbatch over seqlock channels. See :class:`CompiledPipeline`."""
+        return CompiledPipeline(self, **kwargs)
+
     def shutdown(self) -> None:
         for s in self.stages:
             try:
                 ray_tpu.kill(s)
             except Exception:  # noqa: BLE001
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Compiled tier: stage loops + seqlock channels (GPipe and 1F1B)
+# ---------------------------------------------------------------------------
+
+SCHEDULES = ("gpipe", "1f1b")
+
+
+def _schedule_ops(schedule: str, n_stages: int, stage: int,
+                  n_mb: int) -> List[Tuple[str, int]]:
+    """The static per-stage op list for one training step.
+
+    GPipe: all forwards, then all backwards. 1F1B: ``min(n_mb,
+    n_stages-1-stage)`` warmup forwards, then one-forward-one-backward
+    to steady state, then the backward drain. Both run backwards in
+    microbatch order 0..n-1 at every stage, so gradient accumulation
+    order — and therefore the accumulated gradient bits — are identical
+    across schedules."""
+    if schedule == "gpipe":
+        return (
+            [("F", k) for k in range(n_mb)]
+            + [("B", k) for k in range(n_mb)]
+        )
+    if schedule != "1f1b":
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}; expected one of "
+            f"{SCHEDULES}"
+        )
+    warmup = min(n_mb, n_stages - 1 - stage)
+    ops = [("F", k) for k in range(warmup)]
+    nf, nb = warmup, 0
+    while nb < n_mb:
+        if nf < n_mb:
+            ops.append(("F", nf))
+            nf += 1
+        ops.append(("B", nb))
+        nb += 1
+    return ops
+
+
+def _max_live_activations(schedule: str, n_stages: int, stage: int,
+                          n_mb: int) -> int:
+    """Peak number of saved VJPs a stage holds under a schedule (the
+    1F1B memory claim; README documents, tests pin)."""
+    live = peak = 0
+    for op, _ in _schedule_ops(schedule, n_stages, stage, n_mb):
+        live += 1 if op == "F" else -1
+        peak = max(peak, live)
+    return peak
+
+
+def _stage_exec_loop(instance, plan_blob: bytes) -> int:
+    """The per-stage compiled loop (runs as a system actor task via
+    ``__rt_pipe_exec_loop__`` and occupies one executor slot until
+    teardown). Parks on the command channel; each ``step`` command runs
+    the schedule's op list, streaming activations/gradients through the
+    stage-boundary channels, then applies the optimizer and acks.
+
+    Every channel op inside a step carries the op deadline, so a dead
+    neighbor surfaces as a TimeoutError shipped to the driver on the ack
+    channel (or, if the ack write itself cannot complete, as the
+    driver's own step deadline) — never a wedged loop that teardown
+    cannot drain."""
+    from ray_tpu.core.channels import open_channel
+    from ray_tpu.dag import _is_stop
+
+    plan = serialization.unpack(plan_blob)
+    idx, n_stages = plan["stage"], plan["n_stages"]
+    op_t = plan["op_timeout_s"]
+    last = idx == n_stages - 1
+
+    def opt(name, role):
+        h = plan.get(name)
+        return open_channel(h, role) if h is not None else None
+
+    cmd = open_channel(plan["cmd"], "read")
+    ack = open_channel(plan["ack"], "write")
+    fwd_in = open_channel(plan["fwd_in"], "read")
+    fwd_out = opt("fwd_out", "write")
+    bwd_in = opt("bwd_in", "read")
+    bwd_out = opt("bwd_out", "write")
+    tgt_in = opt("tgt", "read")
+    loss_out = opt("loss", "write")
+
+    steps = 0
+    stopping = False
+    while not stopping:
+        frame = cmd.read(timeout_s=None)
+        if _is_stop(frame):
+            break
+        command = serialization.unpack(frame)
+        if command[0] == "get_params":
+            # same ship-don't-die contract as a failed step: params too
+            # big for the ack ring (or a dead driver) must not kill the
+            # parked loop silently
+            try:
+                ack.write_value(instance.get_params(), timeout_s=op_t)
+            except Exception as e:  # noqa: BLE001 — ship to the driver
+                try:
+                    ack.write_value(e, timeout_s=5.0)
+                except Exception:  # noqa: BLE001 — driver gone too
+                    pass
+            continue
+        _, schedule, n_mb, lr = command
+        try:
+            for op, k in _schedule_ops(schedule, n_stages, idx, n_mb):
+                if op == "F":
+                    x = fwd_in.read(timeout_s=op_t)
+                    if _is_stop(x):
+                        stopping = True
+                        break
+                    x = serialization.unpack(x)
+                    if last:
+                        target = tgt_in.read_value(timeout_s=op_t)
+                        loss_out.write_value(
+                            instance.forward_loss(k, x, target),
+                            timeout_s=op_t,
+                        )
+                    else:
+                        fwd_out.write_value(
+                            instance.forward(k, x), timeout_s=op_t
+                        )
+                else:
+                    if last:
+                        g = instance.backward_from_loss(k)
+                    else:
+                        g = instance.backward(
+                            k, bwd_in.read_value(timeout_s=op_t)
+                        )
+                    if bwd_out is not None:
+                        bwd_out.write_value(g, timeout_s=op_t)
+            if stopping:
+                break
+            instance.apply(lr)
+            ack.write_value(("ok", n_mb), timeout_s=op_t)
+            steps += 1
+        except Exception as e:  # noqa: BLE001 — ship to the driver
+            instance.reset_step()
+            try:
+                ack.write_value(e, timeout_s=5.0)
+            except Exception:  # noqa: BLE001 — driver gone too
+                pass
+    for ch in (cmd, ack, fwd_in, fwd_out, bwd_in, bwd_out, tgt_in,
+               loss_out):
+        if ch is not None:
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001 — best-effort reclaim
+                pass
+    return steps
+
+
+class CompiledPipeline:
+    """The compiled form of a :class:`Pipeline`: channels allocated,
+    stage loops parked, every microbatch streamed over seqlock rings.
+
+    Channels per stage boundary (driver counts as both ends):
+
+    - forward activation channel stage i-1 → i (ring of
+      ``channel_slots`` slots × ``channel_capacity`` bytes);
+    - backward gradient channel stage i+1 → i (same geometry);
+    - driver → last-stage target channel, last-stage → driver loss
+      channel (loss ring holds ``max_microbatches`` slots so the last
+      stage NEVER blocks publishing a loss — that bound is what makes
+      the streaming schedule deadlock-free for any microbatch count up
+      to the cap);
+    - per-stage command/ack channels (tiny commands down, step acks /
+      shipped exceptions / fetched params up).
+
+    Same-node edges ride ShmChannel; cross-node edges ride RpcChannel
+    (``RT_PIPELINE_FORCE_RPC_CHANNELS=1`` forces the RPC tier
+    everywhere — the cross-host test/A-B lever).
+
+    Failure contract: every channel op inside ``train_step`` carries
+    the step deadline — a SIGKILLed stage or a wedged neighbor raises
+    within ``step_timeout_s`` (a stage-shipped exception is re-raised
+    verbatim), never hangs; the pipeline is then broken and must be
+    torn down. ``teardown()`` drains and unlinks every channel it
+    created, wedged loops or not."""
+
+    def __init__(
+        self,
+        pipeline: Pipeline,
+        schedule: str = "1f1b",
+        channel_capacity: int = 4 * 1024 * 1024,
+        channel_slots: int = 2,
+        max_microbatches: int = 256,
+        step_timeout_s: float = 60.0,
+    ):
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.core.channels import (
+            RpcChannel, ShmChannel, rpc_channel_handle,
+        )
+
+        if schedule not in SCHEDULES:
+            raise ValueError(
+                f"unknown pipeline schedule {schedule!r}; expected one "
+                f"of {SCHEDULES}"
+            )
+        if channel_slots < 1:
+            raise ValueError("channel_slots must be >= 1")
+        self._pipe = pipeline
+        self.schedule = schedule
+        self._n = len(pipeline.stages)
+        self._capacity = channel_capacity
+        self._slots = channel_slots
+        self._max_mb = max_microbatches
+        self._timeout = step_timeout_s
+        self._broken = False
+        self._torn_down = False
+
+        self._w = worker_mod.global_worker()
+        infos = ray_tpu.get(
+            [s.transport_info.remote() for s in pipeline.stages],
+            timeout=step_timeout_s,
+        )
+        driver = {"node_id": self._w.node_id_hex, "address": self._w.address}
+        force_rpc = bool(config.pipeline_force_rpc_channels)
+
+        self._shm_channels: List[ShmChannel] = []
+
+        def make(writer, reader, capacity, slots):
+            """One stage-boundary channel: shm when both ends live on
+            THE DRIVER'S node (the driver creates the segment, so a
+            same-node pair on a remote host could not attach it — those
+            edges ride the RPC tier too), RPC mailbox on the reader's
+            worker otherwise."""
+            if (not force_rpc
+                    and writer["node_id"] == reader["node_id"]
+                    == driver["node_id"]):
+                ch = ShmChannel.create(capacity, slots=slots)
+                self._shm_channels.append(ch)
+                return ch.handle()
+            return rpc_channel_handle(reader["address"], capacity, slots)
+
+        self._rpc_readers: List[RpcChannel] = []
+
+        def driver_end(handle, role):
+            if handle.get("kind") == "rpc":
+                ch = RpcChannel(handle, role)
+                if role == "read":
+                    self._rpc_readers.append(ch)
+                return ch
+            for ch in self._shm_channels:
+                if ch.path == handle["path"]:
+                    return ch
+            raise RuntimeError("driver end of an unknown channel")
+
+        S = self._n
+        parked_cmds: List[Any] = []
+        try:
+            x_h = [
+                make(driver if i == 0 else infos[i - 1], infos[i],
+                     channel_capacity, channel_slots)
+                for i in range(S)
+            ]
+            g_h = [
+                make(infos[i + 1], infos[i], channel_capacity,
+                     channel_slots)
+                for i in range(S - 1)
+            ]
+            tgt_h = make(driver, infos[S - 1], channel_capacity,
+                         channel_slots)
+            # losses are tiny; a slot per microbatch makes the last
+            # stage's loss publish non-blocking (see class docstring)
+            loss_h = make(infos[S - 1], driver, 16 * 1024,
+                          max_microbatches)
+            cmd_h = [make(driver, infos[i], 64 * 1024, 2)
+                     for i in range(S)]
+            # acks also carry fetched params / shipped exceptions
+            ack_h = [make(infos[i], driver, channel_capacity, 2)
+                     for i in range(S)]
+
+            self._x0 = driver_end(x_h[0], "write")
+            self._tgt = driver_end(tgt_h, "write")
+            self._loss = driver_end(loss_h, "read")
+            self._cmd = [driver_end(h, "write") for h in cmd_h]
+            self._ack = [driver_end(h, "read") for h in ack_h]
+
+            # park the stage loops (their returns arrive at teardown)
+            self._loop_refs = []
+            for i, stage in enumerate(pipeline.stages):
+                plan = {
+                    "stage": i,
+                    "n_stages": S,
+                    "op_timeout_s": step_timeout_s,
+                    "cmd": cmd_h[i],
+                    "ack": ack_h[i],
+                    "fwd_in": x_h[i],
+                    "fwd_out": x_h[i + 1] if i < S - 1 else None,
+                    "bwd_in": g_h[i] if i < S - 1 else None,
+                    "bwd_out": g_h[i - 1] if i > 0 else None,
+                    "tgt": tgt_h if i == S - 1 else None,
+                    "loss": loss_h if i == S - 1 else None,
+                }
+                refs = self._w.submit_actor_task(
+                    stage._actor_id, "__rt_pipe_exec_loop__",
+                    (serialization.pack(plan),), {}, num_returns=1,
+                )
+                self._loop_refs.extend(refs)
+                parked_cmds.append(cmd_h[i])
+        except BaseException:
+            from ray_tpu.core.channels import open_channel
+            from ray_tpu.dag import _STOP
+
+            # a stage died mid-compile (or a channel failed to open):
+            # the half-built object is unreachable, so unwedge every
+            # ALREADY-PARKED loop (else it holds its actor's executor
+            # slot forever) and reclaim every channel NOW — no
+            # /dev/shm/rtchan_* debris from a failed compile
+            for h in parked_cmds:
+                try:
+                    open_channel(h, "write").write(_STOP, timeout_s=1.0)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            for ch in self._shm_channels:
+                try:
+                    ch.close(unlink=True)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            for ch in self._rpc_readers:
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            raise
+
+    # -- driver-side hot path ------------------------------------------
+
+    def _check_usable(self):
+        if self._torn_down:
+            raise RuntimeError("compiled pipeline was torn down")
+        if self._broken:
+            raise RuntimeError(
+                "compiled pipeline is broken (an earlier step failed "
+                "mid-stream); teardown and recompile"
+            )
+
+    def _sniff_stage_error(self) -> Optional[BaseException]:
+        """Non-blocking scan of the ack channels for a shipped stage
+        exception (a failed mid-pipeline stage cannot reach the loss
+        channel, so the driver's loss read times out — the real cause
+        is waiting on that stage's ack channel)."""
+        for ch in self._ack:
+            try:
+                got = ch.read_value(timeout_s=0.0)
+            except Exception:  # noqa: BLE001 — empty/closed: keep looking
+                continue
+            if isinstance(got, BaseException):
+                return got
+        return None
+
+    def train_step(
+        self,
+        microbatches: Sequence[Any],
+        targets: Sequence[Any],
+        lr: float = 1e-2,
+        schedule: Optional[str] = None,
+    ) -> float:
+        """One pipelined training step over the compiled channels.
+        Streams each microbatch (and its target) as soon as the input
+        ring has a free slot, collects the per-microbatch losses, then
+        waits for every stage's apply ack. Returns the mean loss."""
+        self._check_usable()
+        if len(microbatches) != len(targets):
+            raise ValueError("need one target per microbatch")
+        n_mb = len(microbatches)
+        if n_mb > self._max_mb:
+            raise ValueError(
+                f"{n_mb} microbatches > max_microbatches={self._max_mb} "
+                f"(the loss ring is sized at compile time)"
+            )
+        sched = schedule or self.schedule
+        if sched not in SCHEDULES:
+            raise ValueError(f"unknown pipeline schedule {sched!r}")
+        deadline = time.monotonic() + self._timeout
+
+        def remaining():
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                raise TimeoutError("pipeline step deadline exceeded")
+            return rem
+
+        losses: List[float] = []
+        try:
+            command = ("step", sched, n_mb, lr)
+            for ch in self._cmd:
+                ch.write_value(command, timeout_s=remaining())
+            for mb, tv in zip(microbatches, targets):
+                self._x0.write_value(mb, timeout_s=remaining())
+                self._tgt.write_value(tv, timeout_s=remaining())
+                # opportunistic drain: losses stream back while later
+                # microbatches are still being fed
+                while len(losses) < n_mb:
+                    try:
+                        losses.append(self._loss.read_value(timeout_s=0.0))
+                    except TimeoutError:
+                        break
+            while len(losses) < n_mb:
+                losses.append(
+                    self._loss.read_value(timeout_s=remaining())
+                )
+            for ch in self._ack:
+                got = ch.read_value(timeout_s=remaining())
+                if isinstance(got, BaseException):
+                    raise got
+        except BaseException as e:
+            self._broken = True
+            if isinstance(e, TimeoutError):
+                shipped = self._sniff_stage_error()
+                if shipped is not None:
+                    raise shipped from None
+                raise RuntimeError(
+                    f"compiled pipeline step did not complete within "
+                    f"{self._timeout}s — a stage actor likely died "
+                    f"mid-step; teardown() and recompile"
+                ) from e
+            raise
+        return sum(losses) / n_mb
+
+    def get_params(self) -> List[Any]:
+        """Fetch every stage's params through the command/ack channels
+        (the parked loops occupy the actors' executor slots, so plain
+        RPC would queue until teardown). A failure mid-fetch breaks the
+        pipeline: a late params reply left in an ack ring would
+        otherwise be misread as the next step's ack."""
+        self._check_usable()
+        deadline = time.monotonic() + self._timeout
+        out = []
+        try:
+            for ch in self._cmd:
+                ch.write_value(("get_params",),
+                               timeout_s=deadline - time.monotonic())
+            for ch in self._ack:
+                got = ch.read_value(
+                    timeout_s=max(0.1, deadline - time.monotonic())
+                )
+                if isinstance(got, BaseException):
+                    raise got
+                out.append(got)
+        except BaseException:
+            self._broken = True
+            raise
+        return out
+
+    def teardown(self, timeout_s: float = 60.0) -> None:
+        """Stop the stage loops and reclaim every channel. Mirrors
+        CompiledDAG.teardown: keep draining driver-facing channels while
+        the stop sentinel propagates (a loop may be blocked writing a
+        loss/ack the driver never consumed), then unlink all shm
+        segments and close all RPC mailboxes — debris-free even when a
+        loop outlives the drain deadline (which is surfaced, loudly)."""
+        from ray_tpu.core import api
+        from ray_tpu.dag import _STOP
+
+        if self._torn_down:
+            return
+        self._torn_down = True
+        pending = list(self._loop_refs)
+        stop_sent = [False] * len(self._cmd)
+        deadline = time.monotonic() + timeout_s
+        while pending and time.monotonic() < deadline:
+            for i, ch in enumerate(self._cmd):
+                if not stop_sent[i]:
+                    try:
+                        ch.write(_STOP, timeout_s=0.2)
+                        stop_sent[i] = True
+                    except Exception:  # noqa: BLE001 — full/dead: retry
+                        pass
+            for ch in (self._loss, *self._ack):
+                try:
+                    ch.read(timeout_s=0.05)
+                except Exception:  # noqa: BLE001 — empty/closed: fine
+                    pass
+            try:
+                _, pending = api.wait(
+                    pending, num_returns=len(pending), timeout=0.3
+                )
+            except Exception:  # noqa: BLE001 — actor may already be dead
+                pending = []
+                break
+        if pending:
+            logger.warning(
+                "compiled pipeline teardown: %d stage loop(s) still "
+                "running after the %.0fs drain deadline; unlinking all "
+                "channels anyway",
+                len(pending), timeout_s,
+            )
+        for ch in self._shm_channels:
+            ch.close(unlink=True)
+        for ch in self._rpc_readers:
+            ch.close()  # driver-side mailboxes (shm ends closed above)

@@ -194,6 +194,25 @@ config.define("collective_chunk_bytes", 1 * 1024 * 1024)
 config.define("collective_op_timeout_s", 120.0)
 # Quantized allreduce (quant="int8"): elements per blockwise f32 scale.
 config.define("collective_quant_block", 2048)
+# Compiled pipeline (parallel/pipeline.py CompiledPipeline): force EVERY
+# stage-boundary channel onto the cross-host RpcChannel tier even when
+# the stages share a node — the test/A-B lever for the worker<->worker
+# chan_push path (same-node edges normally ride shm seqlock rings).
+config.define("pipeline_force_rpc_channels", False)
+# TPU-RDT device-object export: device->host copy of chunk k overlaps
+# the shm/socket write of chunk k-1 through a depth-2 staging queue
+# (core/device_objects.py write_arrays_overlapped). Chunk size trades
+# overlap granularity against per-chunk bookkeeping (clamped to a
+# 64 KiB floor); rdt_d2h_overlap off falls back to the serial
+# convert-then-write path.
+config.define("rdt_d2h_overlap", True)
+config.define("rdt_d2h_chunk_bytes", 4 * 1024 * 1024)
+# Producer-side eager export: start the (cached, single-flight) segment
+# export the moment a device-transport task return is parked, so the
+# D2H + shm write overlap the consumer task's submit/schedule latency.
+# Off = export lazily on the consumer's first get (the pre-overlap
+# behavior; saves the work when consumers are usually in-process).
+config.define("rdt_eager_export", True)
 config.define("actor_max_restarts", 0)
 config.define("log_to_driver", True)
 config.define("temp_dir", "/tmp/ray_tpu")

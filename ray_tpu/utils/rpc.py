@@ -91,6 +91,7 @@ _FAMILY_PREFIXES = (
     ("remove_placement_group", "pg"),
     ("list_placement_groups", "pg"),
     ("coll_deliver", "collective"),
+    ("chan_push", "channel"),
     ("get_state", "state"),
     ("get_metrics", "state"),
     ("get_task_events", "state"),

@@ -28,6 +28,10 @@ class Adder:
     def add(self, x):
         return x + self.delta
 
+    def slow_add(self, x):
+        time.sleep(6.0)
+        return x + self.delta
+
     def boom(self, x):
         raise ValueError("boom")
 
@@ -147,6 +151,80 @@ def test_execute_inflight_bound(rt):
         assert cdag.execute(4).get() == 5  # drained: capacity back
     finally:
         cdag.teardown()
+
+
+def test_execute_inflight_bound_is_configurable(rt):
+    """experimental_compile(max_inflight=N) streams N unconsumed rounds
+    through the slot rings before raising (satellite: the bound is a
+    compile knob now, not a hardcoded 2)."""
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    cdag = dag.experimental_compile(max_inflight=4)
+    try:
+        refs = [cdag.execute(i) for i in range(4)]  # would raise at 2 before
+        with pytest.raises(RuntimeError, match="unconsumed"):
+            cdag.execute(99)
+        assert [r.get() for r in refs] == [1, 2, 3, 4]
+        assert cdag.execute(10).get() == 11  # drained: capacity back
+    finally:
+        cdag.teardown()
+
+
+def test_compile_rejects_bad_bounds(rt):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    with pytest.raises(ValueError, match="max_inflight"):
+        dag.experimental_compile(max_inflight=0)
+    with pytest.raises(ValueError, match="channel_slots"):
+        dag.experimental_compile(channel_slots=0)
+
+
+def test_teardown_warns_and_unlinks_on_wedged_loop(rt, caplog):
+    """A loop stuck in user code past the drain deadline: teardown must
+    SAY so (not silently fall through) and still unlink every channel —
+    no /dev/shm/rtchan_* debris for sweep_stale_runtime."""
+    import logging
+    import os
+
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.slow_add.bind(inp)
+    cdag = dag.experimental_compile()
+    paths = [
+        ch.path
+        for ch in (cdag._input_channels + cdag._output_channels
+                   + cdag._edge_channels)
+    ]
+    cdag.execute(1)
+    time.sleep(0.3)  # the loop is now inside slow_add's sleep
+    with caplog.at_level(logging.WARNING, logger="ray_tpu.dag"):
+        t0 = time.monotonic()
+        cdag.teardown(timeout_s=1.5)
+        assert time.monotonic() - t0 < 6.0
+    assert "still running" in caplog.text
+    for p in paths:
+        assert not os.path.exists(p), f"teardown leaked {p}"
+        assert not os.path.exists(p + ".d"), f"teardown leaked {p}.d"
+
+
+def test_multi_actor_edge_channels_unlinked(rt):
+    """Actor→actor edge channels (not just driver-facing ones) are
+    reclaimed at teardown."""
+    import os
+
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    cdag = dag.experimental_compile()
+    assert len(cdag._edge_channels) == 1  # the a→b hop
+    paths = [ch.path for ch in cdag._edge_channels]
+    assert cdag.execute(1).get() == 4
+    cdag.teardown()
+    for p in paths:
+        assert not os.path.exists(p), f"edge channel leaked {p}"
 
 
 def test_compiled_path_beats_rpc_path(rt):

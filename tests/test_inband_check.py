@@ -186,3 +186,46 @@ def test_ndarray_ring_chunk_send_is_clean():
             )
     """)
     assert not violations, violations
+
+
+# -- channel-write rule: compiled exec-loop modules (dag/pipeline) -------
+
+
+def _check_channel(body: str, filename="ray_tpu/dag.py"):
+    return check_source(textwrap.dedent(body), filename=filename)
+
+
+def test_flags_packed_channel_write_in_dag():
+    violations = _check_channel("""
+        def _actor_exec_loop(instance, plan):
+            ch.write(serialization.pack(result), timeout_s=None)
+    """)
+    assert len(violations) == 1 and ".write()" in violations[0]
+
+
+def test_flags_aliased_packed_channel_write_in_pipeline():
+    violations = _check_channel("""
+        def _stage_exec_loop(instance, plan):
+            frame = serialization.pack(activation)
+            fwd_out.write(frame)
+    """, filename="ray_tpu/parallel/pipeline.py")
+    assert len(violations) == 1 and "alias 'frame'" in violations[0]
+
+
+def test_write_value_and_stop_sentinel_are_clean():
+    violations = _check_channel("""
+        def _stage_exec_loop(instance, plan):
+            fwd_out.write_value(instance.forward(k, x), timeout_s=t)
+            ch.write_views(serialization.frame_parts(meta, views))
+            cmd.write(_STOP, timeout_s=1.0)
+    """)
+    assert not violations, violations
+
+
+def test_channel_write_rule_only_applies_to_exec_loop_modules():
+    # a file .write() elsewhere (WAL, sockets) is not a channel send
+    violations = _check("""
+        def append(self, value):
+            self._f.write(serialization.dumps(value))
+    """)
+    assert not violations, violations

@@ -60,14 +60,99 @@ def test_message_written_before_attach_is_delivered():
         ch.close(unlink=True)
 
 
-def _echo_peer_script(root, path, cap, env_native):
+def test_multi_slot_ring_wrap():
+    """A slots=3 ring: the writer runs up to 3 ahead, blocks on the 4th,
+    and the reader drains strictly in publish order across many
+    wrap-arounds."""
+    ch = ShmChannel.create(4096, slots=3)
+    rd = ShmChannel.from_handle(ch.handle())
+    try:
+        ch.write(b"m0")
+        ch.write(b"m1")
+        ch.write(b"m2")
+        with pytest.raises(TimeoutError):
+            ch.write(b"m3", timeout_s=0.2)  # ring full
+        assert rd.read(5.0) == b"m0"
+        ch.write(b"m3", timeout_s=5.0)  # one slot freed
+        assert [rd.read(5.0) for _ in range(3)] == [b"m1", b"m2", b"m3"]
+        for i in range(40):  # many wraps of the 3-slot ring
+            ch.write(f"w{i}".encode())
+            assert rd.read(5.0) == f"w{i}".encode()
+    finally:
+        rd.close()
+        ch.close(unlink=True)
+
+
+def test_reader_behind_writer_delivers_in_order():
+    """Messages written before the reader attaches — and while it lags —
+    are all delivered, in order (a ring reader resumes from ack, not
+    from the latest seq)."""
+    ch = ShmChannel.create(4096, slots=4)
+    try:
+        ch.write(b"a")
+        ch.write(b"b")
+        ch.write(b"c")
+        late = ShmChannel.from_handle(ch.handle())
+        try:
+            assert late.read(5.0) == b"a"
+            ch.write(b"d")  # writer keeps going while the reader lags
+            ch.write(b"e")
+            assert [late.read(5.0) for _ in range(4)] == [
+                b"b", b"c", b"d", b"e",
+            ]
+        finally:
+            late.close()
+    finally:
+        ch.close(unlink=True)
+
+
+def test_stop_sentinel_delivered_behind_inflight_slots():
+    """The dag/pipeline teardown sentinel queues BEHIND in-flight
+    messages: a reader with slots in flight consumes them all before
+    seeing the stop."""
+    from ray_tpu.dag import _STOP, _is_stop
+
+    ch = ShmChannel.create(4096, slots=4)
+    rd = ShmChannel.from_handle(ch.handle())
+    try:
+        ch.write_value({"round": 1})
+        ch.write_value({"round": 2})
+        ch.write(_STOP)
+        assert rd.read_value(5.0) == {"round": 1}
+        assert rd.read_value(5.0) == {"round": 2}
+        assert _is_stop(rd.read(5.0))
+    finally:
+        rd.close()
+        ch.close(unlink=True)
+
+
+def test_write_value_scatter_gather_roundtrip():
+    """write_value lands pickle-5 out-of-band buffers straight in the
+    slot; read_value reconstructs, across slot reuse."""
+    import numpy as np
+
+    ch = ShmChannel.create(1 << 20, slots=2)
+    rd = ShmChannel.from_handle(ch.handle())
+    try:
+        for i in range(6):
+            x = {"i": i, "arr": np.arange(i * 1000 + 7, dtype=np.int64)}
+            ch.write_value(x)
+            got = rd.read_value(5.0)
+            assert got["i"] == i
+            np.testing.assert_array_equal(got["arr"], x["arr"])
+    finally:
+        rd.close()
+        ch.close(unlink=True)
+
+
+def _echo_peer_script(root, path, cap, env_native, slots=1):
     return (
         f"import os, sys\n"
         f"os.environ['RT_NATIVE'] = {env_native!r}\n"
         f"sys.path.insert(0, {root!r})\n"
         f"from ray_tpu.core.channels import ShmChannel\n"
-        f"a = ShmChannel.attach({path + '_in'!r}, {cap})\n"
-        f"b = ShmChannel.attach({path + '_out'!r}, {cap})\n"
+        f"a = ShmChannel.attach({path + '_in'!r}, {cap}, slots={slots})\n"
+        f"b = ShmChannel.attach({path + '_out'!r}, {cap}, slots={slots})\n"
         f"for i in range(20):\n"
         f"    b.write(b'echo:' + a.read(30.0))\n"
         f"a.close(); b.close()\n"
@@ -75,18 +160,20 @@ def _echo_peer_script(root, path, cap, env_native):
 
 
 @pytest.mark.parametrize("peer_native", ["1", "0"])
-def test_cross_process_echo_mixed_tiers(tmp_path, peer_native):
+@pytest.mark.parametrize("slots", [1, 3])
+def test_cross_process_echo_mixed_tiers(tmp_path, peer_native, slots):
     """Driver (native if available) against a subprocess peer running the
-    native or PYTHON tier — layout interop both ways."""
+    native or PYTHON tier — ring layout interop both ways, single- and
+    multi-slot."""
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     base = str(tmp_path / "chan")
     cap = 1 << 16
-    a = ShmChannel(base + "_in", cap, create=True)   # driver writes
-    b = ShmChannel(base + "_out", cap, create=True)  # driver reads
+    a = ShmChannel(base + "_in", cap, create=True, slots=slots)
+    b = ShmChannel(base + "_out", cap, create=True, slots=slots)
     env = dict(os.environ)
     proc = subprocess.Popen(
         [sys.executable, "-c",
-         _echo_peer_script(root, base, cap, peer_native)],
+         _echo_peer_script(root, base, cap, peer_native, slots)],
         env=env,
     )
     try:

@@ -1,6 +1,11 @@
 """Pipeline parallelism + shm channel tests (parity model: the
 reference's compiled-graph PP loops, python/ray/dag/tests)."""
 
+import os
+import signal
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -9,7 +14,7 @@ import ray_tpu
 
 @pytest.fixture(scope="module")
 def rt():
-    ray_tpu.init(num_cpus=4)
+    ray_tpu.init(num_cpus=8)
     yield ray_tpu
     ray_tpu.shutdown()
 
@@ -104,6 +109,217 @@ def test_gpipe_matches_unpipelined(rt):
         assert np.asarray(out).shape == (4, 4)
     finally:
         pipe.shutdown()
+
+
+# -- compiled tier: 1F1B/GPipe over seqlock channels ---------------------
+
+
+def test_schedule_ops_properties():
+    """Every (F,k)/(B,k) appears exactly once, backwards run in
+    microbatch order at every stage (the bit-for-bit guarantee), and
+    1F1B's peak live activations match min(n_mb, n_stages - stage) vs
+    GPipe's n_mb."""
+    from ray_tpu.parallel.pipeline import (
+        _max_live_activations, _schedule_ops,
+    )
+
+    for schedule in ("gpipe", "1f1b"):
+        for n_stages in (1, 2, 4):
+            for n_mb in (1, 3, 8):
+                for stage in range(n_stages):
+                    ops = _schedule_ops(schedule, n_stages, stage, n_mb)
+                    fwd = [k for op, k in ops if op == "F"]
+                    bwd = [k for op, k in ops if op == "B"]
+                    assert fwd == list(range(n_mb))
+                    assert bwd == list(range(n_mb))
+                    # a backward can never precede its own forward
+                    seen_f = set()
+                    for op, k in ops:
+                        if op == "F":
+                            seen_f.add(k)
+                        else:
+                            assert k in seen_f
+    # the 1F1B memory claim
+    assert _max_live_activations("gpipe", 4, 0, 8) == 8
+    assert _max_live_activations("1f1b", 4, 0, 8) == 4
+    assert _max_live_activations("1f1b", 4, 3, 8) == 1
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        _schedule_ops("pipedream", 2, 0, 4)
+
+
+def test_compiled_gpipe_matches_unpipelined(rt):
+    from ray_tpu.parallel.pipeline import Pipeline
+
+    W1, W2, X, Y, stage1, stage2, loss_fn = _two_stage_problem()
+    pipe = Pipeline([stage1, stage2], [{"w": W1}, {"w": W2}], loss_fn)
+    cp = pipe.compile(schedule="gpipe", step_timeout_s=60.0)
+    try:
+        n_mb, lr = 4, 0.1
+        loss = cp.train_step(
+            list(np.split(X, n_mb)), list(np.split(Y, n_mb)), lr=lr
+        )
+        ref_params, ref_loss = _reference_step(W1, W2, X, Y, lr, n_mb)
+        assert abs(loss - ref_loss) < 5e-3
+        p1, p2 = cp.get_params()
+        np.testing.assert_allclose(
+            np.asarray(p1["w"]), np.asarray(ref_params["w1"]),
+            rtol=5e-3, atol=5e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(p2["w"]), np.asarray(ref_params["w2"]),
+            rtol=5e-3, atol=5e-4,
+        )
+        first = loss
+        for _ in range(5):
+            loss = cp.train_step(
+                list(np.split(X, n_mb)), list(np.split(Y, n_mb)), lr=lr
+            )
+        assert loss < first
+    finally:
+        cp.teardown(timeout_s=30.0)
+        pipe.shutdown()
+
+
+def test_compiled_1f1b_matches_gpipe_bitwise(rt):
+    """The headline 1F1B guarantee: identical microbatch computations in
+    identical backward order — the post-step params are BIT-IDENTICAL
+    to GPipe's (and the losses match exactly)."""
+    from ray_tpu.parallel.pipeline import Pipeline
+
+    W1, W2, X, Y, stage1, stage2, loss_fn = _two_stage_problem()
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        pipe = Pipeline([stage1, stage2], [{"w": W1}, {"w": W2}], loss_fn)
+        cp = pipe.compile(schedule=sched, step_timeout_s=60.0)
+        try:
+            losses = [
+                cp.train_step(
+                    list(np.split(X, 8)), list(np.split(Y, 8)), lr=0.1
+                )
+                for _ in range(2)
+            ]
+            results[sched] = (losses, cp.get_params())
+        finally:
+            cp.teardown(timeout_s=30.0)
+            pipe.shutdown()
+    g_losses, g_params = results["gpipe"]
+    o_losses, o_params = results["1f1b"]
+    assert g_losses == o_losses  # exact float equality
+    for gp, op in zip(g_params, o_params):
+        np.testing.assert_array_equal(
+            np.asarray(gp["w"]), np.asarray(op["w"])
+        )
+
+
+def test_compiled_pipeline_rpc_channel_tier(rt):
+    """Force every stage boundary onto the cross-host RpcChannel tier
+    (worker<->worker chan_push, out-of-band multiseg payloads) — the
+    numbers must match the shm tier exactly."""
+    from ray_tpu.parallel.pipeline import Pipeline
+    from ray_tpu.utils.config import config
+
+    W1, W2, X, Y, stage1, stage2, loss_fn = _two_stage_problem()
+    pipe = Pipeline([stage1, stage2], [{"w": W1}, {"w": W2}], loss_fn)
+    config.set("pipeline_force_rpc_channels", True)
+    try:
+        cp = pipe.compile(schedule="1f1b", step_timeout_s=60.0)
+    finally:
+        config.set("pipeline_force_rpc_channels", False)
+    try:
+        n_mb, lr = 4, 0.1
+        loss = cp.train_step(
+            list(np.split(X, n_mb)), list(np.split(Y, n_mb)), lr=lr
+        )
+        _, ref_loss = _reference_step(W1, W2, X, Y, lr, n_mb)
+        assert abs(loss - ref_loss) < 5e-3
+        loss2 = cp.train_step(
+            list(np.split(X, n_mb)), list(np.split(Y, n_mb)), lr=lr
+        )
+        assert loss2 < loss
+    finally:
+        cp.teardown(timeout_s=30.0)
+        pipe.shutdown()
+
+
+def test_compiled_pipeline_chaos_sigkill_mid_step(rt):
+    """SIGKILL a MID-pipeline stage during a 1F1B step: the driver must
+    raise within the step deadline (no hang), and teardown must still
+    reclaim every channel (no /dev/shm/rtchan_* debris)."""
+    from ray_tpu.parallel.pipeline import Pipeline
+
+    rng = np.random.default_rng(1)
+    Ws = [rng.normal(size=(8, 8)).astype(np.float32) * 0.3
+          for _ in range(3)]
+    X = rng.normal(size=(32, 8)).astype(np.float32)
+    Y = rng.normal(size=(32, 8)).astype(np.float32)
+
+    def slow_stage(params, x):
+        import time
+
+        import jax.numpy as jnp
+
+        time.sleep(0.05)  # stretch the step so the kill lands MID-step
+        return jnp.tanh(x @ params["w"])
+
+    def loss_fn(pred, target):
+        import jax.numpy as jnp
+
+        return jnp.mean((pred - target) ** 2)
+
+    pipe = Pipeline([slow_stage] * 3, [{"w": w} for w in Ws], loss_fn)
+    victim_pid = ray_tpu.get(pipe.stages[1].pid.remote(), timeout=30)
+    cp = pipe.compile(schedule="1f1b", step_timeout_s=8.0)
+    shm_paths = [ch.path for ch in cp._shm_channels]
+    assert shm_paths, "expected shm channels on the same-host pipeline"
+
+    killer = threading.Timer(
+        0.3, lambda: os.kill(victim_pid, signal.SIGKILL)
+    )
+    killer.start()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(Exception):
+            cp.train_step(list(np.split(X, 8)), list(np.split(Y, 8)),
+                          lr=0.1)
+        # raised within the op deadline (plus slack), not a hang
+        assert time.monotonic() - t0 < 20.0
+        # broken pipeline refuses further steps
+        with pytest.raises(RuntimeError, match="broken"):
+            cp.train_step(list(np.split(X, 8)), list(np.split(Y, 8)))
+    finally:
+        killer.cancel()
+        cp.teardown(timeout_s=15.0)
+        pipe.shutdown()
+    for p in shm_paths:
+        assert not os.path.exists(p), f"teardown leaked {p}"
+        assert not os.path.exists(p + ".d")
+
+
+def test_rpc_mailbox_semantics(rt):
+    """RpcChannel receiver mailbox: bounded, idempotent per seq, and
+    closed STAYS closed (a writer retry racing close must bounce, not
+    silently recreate an orphan mailbox)."""
+    from ray_tpu.core.channels import (
+        close_rpc_mailbox, rpc_channel_deliver,
+    )
+
+    cid = "rtchan_test_mailbox"
+    assert rpc_channel_deliver(cid, 1, b"a", 2)["status"] == "ok"
+    assert rpc_channel_deliver(cid, 1, b"a", 2)["status"] == "ok"  # dup
+    assert rpc_channel_deliver(cid, 2, b"b", 2)["status"] == "ok"
+    assert rpc_channel_deliver(cid, 3, b"c", 2)["status"] == "full"
+    from ray_tpu.core import channels as channels_mod
+
+    mb = channels_mod._mailbox(cid, 2)
+    with mb.cv:
+        mb.q.popleft()
+        mb.consumed += 1
+    assert rpc_channel_deliver(cid, 3, b"c", 2)["status"] == "ok"
+    close_rpc_mailbox(cid)
+    # tombstoned: late writer retries bounce forever (chan ids are
+    # one-shot uuids, never legitimately reused)
+    assert rpc_channel_deliver(cid, 4, b"d", 2)["status"] == "closed"
+    close_rpc_mailbox(cid)  # idempotent
 
 
 def test_shm_channel_roundtrip(rt):

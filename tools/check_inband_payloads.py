@@ -54,10 +54,32 @@ HOT_PATHS = (
     # ~100 B rendezvous records may pack in-band (opted out per line)
     os.path.join("ray_tpu", "collective", "p2p.py"),
     os.path.join("ray_tpu", "collective", "collective.py"),
+    # compiled-graph / compiled-pipeline exec loops: microbatch
+    # activations move via channel writes — see CHANNEL_SEND_PATHS
+    os.path.join("ray_tpu", "dag.py"),
+    os.path.join("ray_tpu", "parallel", "pipeline.py"),
 )
 
 RPC_SEND_METHODS = {"call", "call_async", "call_oneway", "push",
                     "push_encoded", "reply"}
+# In the compiled exec-loop modules a channel ``.write(pack(...))`` is
+# the same in-band join-copy an RPC send would be: activations ≥32 KiB
+# must ride ``write_value``/``write_views`` (scatter-gather straight
+# into the shm slot; Frame-wrapped multiseg segments on the RpcChannel
+# tier). Only the tiny _STOP sentinel goes through raw ``.write``.
+CHANNEL_SEND_METHODS = {"write"}
+CHANNEL_SEND_PATHS = (
+    os.path.join("ray_tpu", "dag.py"),
+    os.path.join("ray_tpu", "parallel", "pipeline.py"),
+)
+
+
+def send_methods_for(filename: str):
+    """The send-method set a file is checked against: RPC sends
+    everywhere, plus channel writes in the exec-loop modules."""
+    if filename.endswith(CHANNEL_SEND_PATHS):
+        return RPC_SEND_METHODS | CHANNEL_SEND_METHODS
+    return RPC_SEND_METHODS
 RAW_SERIALIZERS = {"pack", "dumps", "pack_parts"}
 WRAPPERS = {"Frame", "maybe_frame"}
 # reply producers: the return value travels as the RPC response payload
@@ -155,7 +177,10 @@ def _dirty_payloads_expr(root, aliases: Set[str]):
             stack.append(child)
 
 
-def check_source(src: str, filename: str = "<source>") -> List[str]:
+def check_source(src: str, filename: str = "<source>",
+                 send_methods=None) -> List[str]:
+    if send_methods is None:
+        send_methods = send_methods_for(filename)
     tree = ast.parse(src, filename=filename)
     lines = src.splitlines()
     violations: List[str] = []
@@ -170,7 +195,7 @@ def check_source(src: str, filename: str = "<source>") -> List[str]:
     for fn in functions:
         aliases = _raw_aliases(fn)
         for node in ast.walk(fn):
-            if _call_attr(node) not in RPC_SEND_METHODS:
+            if _call_attr(node) not in send_methods:
                 continue
             for dirty in _dirty_payloads(node, aliases):
                 if opted_out(node.lineno) or opted_out(dirty.lineno):

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""RDT device-handoff budget profiler (ROADMAP item 3 / PR 8).
+
+Decomposes the ``rdt_vs_pickle_speedup`` benchmark into its budget
+lines so a target miss is pinned to a specific line instead of hand-
+waved (PROFILE.md "RDT device-handoff budget" records the conclusions):
+
+  stage A  export budget: D2H convert, create_object RPC, segment
+           pwrite at a sweep of chunk sizes (the double-buffer
+           granularity), seal RPC — inside the holder process.
+  stage B  common-cost floor: the handoff loop with a ZERO-payload
+           task pair (same task machinery, no bytes) plus the
+           producer's make() and consumer's sum() compute in isolation.
+  stage C  end-to-end A/B: pickle vs device handoff at 4 MiB / 64 MiB
+           with the overlap + eager-export flags on vs off,
+           interleaved on the same cluster.
+
+Run: JAX_PLATFORMS=cpu python tools/exp_rdt_profile.py
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import ray_tpu
+    from ray_tpu.core import cluster_utils
+
+    cluster_utils.sweep_stale_runtime()
+    ray_tpu.init(num_cpus=8)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    out = {}
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self, n):
+            import jax.numpy as jnp
+
+            return jnp.zeros((n, 1024))
+
+        def nothing(self):
+            return None
+
+        def set_flag(self, name, v):
+            from ray_tpu.utils.config import config
+
+            config.set(name, v)
+            return True
+
+        def compute_costs(self, n):
+            """make() and a local sum() in isolation (no transfer)."""
+            import time
+
+            import jax.numpy as jnp
+
+            t0 = time.perf_counter()
+            a = jnp.zeros((n, 1024))
+            a.block_until_ready()
+            make_s = time.perf_counter() - t0
+            float(a.sum())  # compile
+            t0 = time.perf_counter()
+            s = float(a.sum())
+            sum_s = time.perf_counter() - t0
+            return {"make_ms": make_s * 1e3, "sum_ms": sum_s * 1e3,
+                    "_": s}
+
+        def export_budget(self, n, chunk_sweep):
+            """Stage A: the export pieces, chunk-size sweep for the
+            write half."""
+            import os
+            import time
+
+            import jax.numpy as jnp
+            import numpy as np
+
+            from ray_tpu.core import worker as worker_mod
+
+            w = worker_mod.global_worker()
+            a = jnp.ones((n, 1024))
+            t = {}
+            t0 = time.perf_counter()
+            host = np.ascontiguousarray(np.asarray(a))
+            t["d2h_convert_ms"] = (time.perf_counter() - t0) * 1e3
+            t["d2h_zero_copy"] = not host.flags.owndata
+            mv = memoryview(host).cast("B")
+            t["pwrite_ms_by_chunk"] = {}
+            for chunk in chunk_sweep:
+                oid = f"prof_{n}_{chunk}"
+                t0 = time.perf_counter()
+                path = w.agent.call("create_object", oid_hex=oid,
+                                    size=mv.nbytes)
+                create_s = time.perf_counter() - t0
+                fd = os.open(path, os.O_RDWR)
+                t0 = time.perf_counter()
+                off = 0
+                while off < mv.nbytes:
+                    off += os.pwrite(fd, mv[off:off + chunk], off)
+                t["pwrite_ms_by_chunk"][str(chunk)] = (
+                    (time.perf_counter() - t0) * 1e3
+                )
+                os.close(fd)
+                t0 = time.perf_counter()
+                w.agent.call("seal_object", oid_hex=oid)
+                t["seal_ms"] = (time.perf_counter() - t0) * 1e3
+                t["create_ms"] = create_s * 1e3
+                w.agent.call("delete_objects", oid_hexes=[oid])
+            return t
+
+    @ray_tpu.remote
+    class Consumer:
+        def total(self, arr):
+            return float(arr.sum())
+
+        def nothing(self, x):
+            return None
+
+    p, c = Producer.remote(), Consumer.remote()
+
+    # -- stage A: export budget + chunk sweep ---------------------------
+    sweep = [256 * 1024, 1024 * 1024, 4 * 1024 * 1024, 16 * 1024 * 1024,
+             64 * 1024 * 1024]
+    for n, tag in ((1024, "4mb"), (16 * 1024, "64mb")):
+        out[f"export_budget_{tag}"] = ray_tpu.get(
+            p.export_budget.remote(n, sweep), timeout=300
+        )
+        out[f"compute_{tag}"] = {
+            k: round(v, 3) if isinstance(v, float) else v
+            for k, v in ray_tpu.get(
+                p.compute_costs.remote(n), timeout=300
+            ).items() if k != "_"
+        }
+
+    # -- stage B: task-machinery floor ----------------------------------
+    ray_tpu.get(c.nothing.remote(p.nothing.remote()))
+    t0 = time.perf_counter()
+    iters = 20
+    for _ in range(iters):
+        ray_tpu.get(c.nothing.remote(p.nothing.remote()))
+    out["task_pair_floor_ms"] = round(
+        (time.perf_counter() - t0) / iters * 1e3, 2
+    )
+
+    # -- stage C: end-to-end A/B ----------------------------------------
+    def handoff(n, dev, iters):
+        fn = (p.make.options(tensor_transport="device") if dev
+              else p.make)
+        ray_tpu.get(c.total.remote(fn.remote(n)))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ray_tpu.get(c.total.remote(fn.remote(n)))
+        return (time.perf_counter() - t0) / iters
+
+    for n, tag, iters in ((1024, "4mb", 12), (16 * 1024, "64mb", 5)):
+        rows = {}
+        for mode, flags in (("overlap_on", True), ("overlap_off", False)):
+            pick, dev = [], []
+            for _ in range(3):
+                ray_tpu.get(p.set_flag.remote("rdt_eager_export", flags))
+                ray_tpu.get(p.set_flag.remote("rdt_d2h_overlap", flags))
+                pick.append(handoff(n, False, iters))
+                dev.append(handoff(n, True, iters))
+            rows[mode] = {
+                "pickle_ms": round(min(pick) * 1e3, 1),
+                "device_ms": round(min(dev) * 1e3, 1),
+                "speedup_x": round(min(pick) / min(dev), 2),
+            }
+        ray_tpu.get(p.set_flag.remote("rdt_eager_export", True))
+        ray_tpu.get(p.set_flag.remote("rdt_d2h_overlap", True))
+        out[f"handoff_{tag}"] = rows
+        print(json.dumps({f"handoff_{tag}": rows}), flush=True)
+
+    print(json.dumps(out, indent=2))
+    ray_tpu.shutdown()
+    return out
+
+
+if __name__ == "__main__":
+    main()
