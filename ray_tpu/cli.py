@@ -30,6 +30,175 @@ def _fmt_table(rows: List[dict], columns: List[str]) -> str:
     return "\n".join(lines)
 
 
+def _hist_quantile(bounds, buckets, q) -> Optional[float]:
+    """Bucket-interpolated quantile from a merged histogram series.
+    None when bucket detail was dropped (divergent boundaries across
+    workers) or the series is empty."""
+    total = sum(buckets)
+    if not bounds or not total:
+        return None
+    rank = q * total
+    cum = 0
+    lo = 0.0
+    for i, n in enumerate(buckets):
+        hi = bounds[i] if i < len(bounds) else bounds[-1]
+        if n and cum + n >= rank:
+            return lo + (hi - lo) * ((rank - cum) / n)
+        cum += n
+        lo = hi
+    return bounds[-1]
+
+
+def _render_top(mx: dict, reqs: dict, qps: Optional[dict]) -> str:
+    """One `rt top` frame from a state.cluster_metrics() aggregate and a
+    state.request_summary() rollup. ``qps`` maps deployment -> req/s
+    computed by the caller from successive router-counter frames (None
+    on the first frame / --once)."""
+
+    def metric(name: str) -> dict:
+        return mx.get(name) or {"series": {}, "tag_keys": ()}
+
+    def tags(m: dict, key) -> dict:
+        return dict(zip(m.get("tag_keys", ()), key))
+
+    def scalar_sum(name: str) -> float:
+        return sum(metric(name)["series"].values())
+
+    def by_tag(name: str, tag: str) -> dict:
+        """Sum a counter/gauge's series per value of one tag."""
+        m = metric(name)
+        out: dict = {}
+        for k, v in m["series"].items():
+            t = tags(m, k).get(tag) or "?"
+            out[t] = out.get(t, 0.0) + v
+        return out
+
+    def hist_by_tag(name: str, tag: str) -> dict:
+        """Per-tag merged (bounds, buckets, count, sum) for a histogram."""
+        m = metric(name)
+        bounds = m.get("boundaries", ())
+        out: dict = {}
+        for k, v in m["series"].items():
+            t = tags(m, k).get(tag) or "?"
+            cur = out.setdefault(
+                t, {"bounds": bounds, "buckets": [0] * len(v["buckets"]),
+                    "count": 0, "sum": 0.0},
+            )
+            cur["count"] += v["count"]
+            cur["sum"] += v["sum"]
+            cur["buckets"] = [
+                a + b for a, b in zip(cur["buckets"], v["buckets"])
+            ] or list(v["buckets"])
+        return out
+
+    def ms(v: Optional[float]) -> str:
+        return f"{v * 1e3:.1f}" if v is not None else "-"
+
+    out = []
+    out.append(
+        f"sched queue {scalar_sum('rt_sched_queue_depth'):g}  |  "
+        f"object store {int(scalar_sum('rt_object_store_used_bytes')):,} B  |  "
+        f"channel write blocks {scalar_sum('rt_channel_write_blocks_total'):g}"
+        f"  |  events dropped "
+        f"{scalar_sum('rt_task_events_dropped_total'):g}"
+    )
+
+    # -- serve: one row per deployment --
+    rows: dict = {}
+
+    def row(dep: str) -> dict:
+        return rows.setdefault(dep, {"deployment": dep})
+
+    for dep, v in by_tag("rt_serve_router_requests_total",
+                         "deployment").items():
+        row(dep)["reqs"] = int(v)
+    for dep, v in by_tag("rt_serve_tokens_generated_total",
+                         "deployment").items():
+        row(dep)["tokens"] = int(v)
+    for dep, v in by_tag("rt_serve_kv_slots_occupied", "deployment").items():
+        row(dep)["kv_slots"] = f"{v:g}"
+    for dep, v in by_tag("rt_serve_queued_requests", "deployment").items():
+        row(dep)["queued"] = f"{v:g}"
+    for dep, h in hist_by_tag("rt_serve_ttft_s", "deployment").items():
+        r = row(dep)
+        r["ttft_p50_ms"] = ms(_hist_quantile(h["bounds"], h["buckets"], 0.5))
+        r["ttft_p95_ms"] = ms(_hist_quantile(h["bounds"], h["buckets"], 0.95))
+    for dep, h in hist_by_tag("rt_serve_inter_token_s", "deployment").items():
+        row(dep)["itl_p50_ms"] = ms(
+            _hist_quantile(h["bounds"], h["buckets"], 0.5)
+        )
+    for dep, h in hist_by_tag("rt_serve_batch_fill", "deployment").items():
+        if h["count"]:
+            row(dep)["batch_fill"] = f"{h['sum'] / h['count']:.1f}"
+    for dep, r in rows.items():
+        r["qps"] = (
+            f"{qps.get(dep, 0.0):.1f}" if qps is not None else "-"
+        )
+    out.append("")
+    out.append("serve")
+    out.append(_fmt_table(
+        [rows[d] for d in sorted(rows)],
+        ["deployment", "reqs", "qps", "ttft_p50_ms", "ttft_p95_ms",
+         "itl_p50_ms", "tokens", "kv_slots", "queued", "batch_fill"],
+    ))
+
+    # -- request summary: e2e / queue / exec percentiles per deployment --
+    rrows = []
+    for dep, entry in sorted((reqs.get("deployments") or {}).items()):
+        e2e = entry.get("e2e_s") or {}
+        rrows.append({
+            "deployment": dep,
+            "count": entry.get("count", 0),
+            "e2e_p50_ms": ms(e2e.get("p50")),
+            "e2e_p95_ms": ms(e2e.get("p95")),
+            "e2e_p99_ms": ms(e2e.get("p99")),
+            "queue_p50_ms": ms((entry.get("queue_s") or {}).get("p50")),
+            "exec_p50_ms": ms((entry.get("exec_s") or {}).get("p50")),
+        })
+    out.append("")
+    out.append("requests (traced)")
+    out.append(_fmt_table(rrows, [
+        "deployment", "count", "e2e_p50_ms", "e2e_p95_ms", "e2e_p99_ms",
+        "queue_p50_ms", "exec_p50_ms",
+    ]))
+
+    # -- pipeline: bubble fraction + busy time per stage/schedule --
+    m = metric("rt_pipeline_bubble_fraction")
+    busy = hist_by_tag("rt_pipeline_stage_busy_s", "stage")
+    prow: dict = {}
+    for k, v in m["series"].items():
+        t = tags(m, k)
+        key = (t.get("stage") or "?", t.get("schedule") or "?")
+        cur = prow.setdefault(
+            key, {"stage": key[0], "schedule": key[1], "steps": 0,
+                  "_sum": 0.0},
+        )
+        cur["steps"] += v["count"]
+        cur["_sum"] += v["sum"]
+    prows = []
+    for key in sorted(prow):
+        r = prow[key]
+        r["bubble_pct"] = (
+            f"{100.0 * r['_sum'] / r['steps']:.1f}" if r["steps"] else "-"
+        )
+        b = busy.get(r["stage"])
+        r["busy_p50_ms"] = ms(
+            _hist_quantile(b["bounds"], b["buckets"], 0.5) if b else None
+        )
+        prows.append(r)
+    out.append("")
+    out.append("pipeline")
+    out.append(_fmt_table(prows, [
+        "stage", "schedule", "steps", "bubble_pct", "busy_p50_ms",
+    ]))
+    if reqs.get("events_dropped"):
+        out.append(
+            f"warning: {reqs['events_dropped']} events dropped from "
+            f"bounded buffers"
+        )
+    return "\n".join(out)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="rt", description="ray_tpu cluster CLI"
@@ -82,6 +251,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="per-task queue-wait / exec latency percentiles",
     )
     sub.add_parser("metrics", help="aggregated metrics (Prometheus text)")
+    top = sub.add_parser(
+        "top",
+        help="live serving / pipeline SLO view (QPS, TTFT, KV occupancy, "
+             "bubble fraction, queue depths)",
+    )
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="refresh period in seconds")
+    top.add_argument("--once", action="store_true",
+                     help="render a single frame and exit (no screen "
+                          "clearing; scriptable)")
     dash = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     dash.add_argument("--port", type=int, default=8265)
     dash.add_argument(
@@ -298,6 +477,51 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         print(metrics_mod.prometheus_text(state.cluster_metrics(addr)), end="")
         return 0
+    if args.cmd == "top":
+        import time as _time
+
+        def frame(qps):
+            mx = state.cluster_metrics(addr)
+            reqs = state.request_summary(addr)
+            if args.as_json:
+                return mx, json.dumps(
+                    {"metrics": {
+                        name: dict(m, series={
+                            ",".join(k): v for k, v in m["series"].items()
+                        }) for name, m in mx.items()
+                    }, "requests": reqs}, indent=2, default=str,
+                )
+            return mx, _render_top(mx, reqs, qps)
+
+        if args.once:
+            print(frame(None)[1])
+            return 0
+        prev: Optional[dict] = None
+        prev_t = 0.0
+        qps: Optional[dict] = None
+        try:
+            while True:
+                mx, text = frame(qps)
+                # QPS = router-counter delta over the frame gap
+                m = mx.get("rt_serve_router_requests_total") or {}
+                cur = {}
+                for k, v in m.get("series", {}).items():
+                    dep = dict(
+                        zip(m.get("tag_keys", ()), k)
+                    ).get("deployment") or "?"
+                    cur[dep] = cur.get(dep, 0.0) + v
+                now = _time.monotonic()
+                if prev is not None and now > prev_t:
+                    qps = {
+                        d: max(v - prev.get(d, 0.0), 0.0) / (now - prev_t)
+                        for d, v in cur.items()
+                    }
+                prev, prev_t = cur, now
+                sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+                sys.stdout.flush()
+                _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
     if args.cmd == "dashboard":
         import time as _time
 

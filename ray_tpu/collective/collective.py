@@ -42,7 +42,7 @@ import numpy as np
 
 from ray_tpu.collective import p2p
 from ray_tpu.core.exceptions import CollectiveError  # noqa: F401 — re-export
-from ray_tpu.observability import core_metrics
+from ray_tpu.observability import core_metrics, tracing
 from ray_tpu.utils import serialization
 
 
@@ -107,6 +107,11 @@ def _observe(op: str, t0: float) -> None:
         core_metrics.collective_op_latency_s.observe(
             time.monotonic() - t0, tags={"op": op}
         )
+    if tracing.ENABLED:
+        # timeline slice for the op, joining the already-counted byte
+        # metrics into the same view as task/request/pipeline slices
+        ts = tracing.mono_us(t0)
+        tracing.emit(tracing.collective_span(op, ts, tracing.now_us() - ts))
 
 
 def _count_kv_bytes(op: str, nbytes: int) -> None:

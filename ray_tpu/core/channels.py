@@ -62,6 +62,7 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.observability import core_metrics
 from ray_tpu.utils import serialization
 
 _HDR = struct.Struct("<QQQQ")  # seq, ack, nslots, slot_cap
@@ -255,6 +256,10 @@ class ShmChannel:
                         off += v.nbytes
                     rc = lib.rt_chan_write_commit(handle, total)
             if rc == -1:
+                if core_metrics.ENABLED:
+                    core_metrics.channel_write_blocks.inc(
+                        tags={"transport": "shm"}
+                    )
                 raise TimeoutError(
                     f"channel {self.path}: ring full — reader never "
                     f"consumed (slots={self.slots})"
@@ -264,6 +269,9 @@ class ShmChannel:
             return
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         seq = self._u64(0)  # single writer: only we advance it
+        if core_metrics.ENABLED and not (seq - self._u64(8) < self.slots):
+            # about to block on a full ring: writer-side backpressure
+            core_metrics.channel_write_blocks.inc(tags={"transport": "shm"})
         # flow control: block while every slot holds an unconsumed message
         self._await(
             lambda: seq - self._u64(8) < self.slots, self._abell, deadline,
@@ -532,6 +540,10 @@ class RpcChannel:
             # full: bounded-mailbox backpressure. Back off exponentially
             # so a long consumer stall costs ~20 polls/s, not a 500/s
             # RPC storm against the receiver's dispatcher pool.
+            if core_metrics.ENABLED:
+                core_metrics.channel_write_blocks.inc(
+                    tags={"transport": "rpc"}
+                )
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.05)
 

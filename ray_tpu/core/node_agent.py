@@ -323,6 +323,8 @@ class NodeAgent:
     def _update_pool_gauge_locked(self) -> None:
         """Refresh rt_worker_pool_size{state=...,node=...} from the live
         pool."""
+        if not core_metrics.ENABLED:
+            return
         counts: Dict[str, int] = {"idle": 0, "leased": 0, "dead": 0}
         for w in self._workers.values():
             counts[w.state] = counts.get(w.state, 0) + 1

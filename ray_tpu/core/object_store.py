@@ -108,8 +108,11 @@ class ShmObjectStore:
         self._objects: Dict[str, _Entry] = {}
 
     def _publish_gauges_locked(self) -> None:
-        """Refresh the built-in store gauges; call sites hold the lock and
-        guard on core_metrics.ENABLED."""
+        """Refresh the built-in store gauges; call sites hold the lock
+        (the ENABLED guard here also keeps belt-and-braces call sites
+        honest)."""
+        if not core_metrics.ENABLED:
+            return
         tags = {"node": self._node_tag}
         core_metrics.object_store_used_bytes.set(self._used, tags=tags)
         core_metrics.object_store_spilled_bytes.set(

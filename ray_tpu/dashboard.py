@@ -37,7 +37,8 @@ _PAGE = """<!doctype html>
 <h2>actors</h2>{actors}
 <h2>jobs</h2>{jobs}
 <p>APIs: /api/status /api/nodes /api/actors /api/jobs /api/workers
-/api/placement_groups /api/timeline /api/task_summary /metrics</p>
+/api/placement_groups /api/timeline /api/task_summary
+/api/request_summary /metrics</p>
 </body></html>"""
 
 
@@ -140,6 +141,7 @@ class Dashboard:
             "/api/placement_groups": lambda: state.list_placement_groups(addr),
             "/api/timeline": lambda: state.timeline(addr),
             "/api/task_summary": lambda: state.task_summary(addr),
+            "/api/request_summary": lambda: state.request_summary(addr),
         }
         if path in apis:
             return (

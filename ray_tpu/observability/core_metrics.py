@@ -120,6 +120,73 @@ def _build() -> dict:
             "batch executes",
             boundaries=_LATENCY_BOUNDS,
         ),
+        # -- LLM serving (serve/llm.py, serve/openai/ingress.py) --
+        "serve_ttft_s": Histogram(
+            "rt_serve_ttft_s",
+            "time from request admission to first generated token",
+            boundaries=_LATENCY_BOUNDS,
+            tag_keys=("deployment",),
+        ),
+        "serve_inter_token_s": Histogram(
+            "rt_serve_inter_token_s",
+            "gap between consecutive generated tokens of one request",
+            boundaries=_LATENCY_BOUNDS,
+            tag_keys=("deployment",),
+        ),
+        "serve_tokens_generated": Counter(
+            "rt_serve_tokens_generated_total",
+            "tokens generated by the LLM engine",
+            tag_keys=("deployment",),
+        ),
+        "serve_kv_slots_occupied": Gauge(
+            "rt_serve_kv_slots_occupied",
+            "KV-cache slots currently holding an in-flight request, per "
+            "engine process",
+            tag_keys=("deployment", "node"),
+        ),
+        "serve_queued_requests": Gauge(
+            "rt_serve_queued_requests",
+            "requests waiting for a KV slot in this engine process",
+            tag_keys=("deployment", "node"),
+        ),
+        "serve_batch_fill": Histogram(
+            "rt_serve_batch_fill",
+            "occupied KV slots per continuous-batching decode round",
+            boundaries=_BATCH_BOUNDS,
+            tag_keys=("deployment",),
+        ),
+        "serve_multiplex_loads": Counter(
+            "rt_serve_multiplex_loads_total",
+            "per-model multiplex loads (cold model pulled into a replica)",
+            tag_keys=("model",),
+        ),
+        "serve_multiplex_evictions": Counter(
+            "rt_serve_multiplex_evictions_total",
+            "per-model multiplex LRU evictions",
+            tag_keys=("model",),
+        ),
+        # -- compiled pipelines (parallel/pipeline.py) --
+        "pipeline_stage_busy_s": Histogram(
+            "rt_pipeline_stage_busy_s",
+            "per-stage compute time (fwd+bwd) per compiled-pipeline step",
+            boundaries=_LATENCY_BOUNDS,
+            tag_keys=("stage",),
+        ),
+        "pipeline_bubble_fraction": Histogram(
+            "rt_pipeline_bubble_fraction",
+            "per-stage idle/(idle+busy) fraction per compiled-pipeline "
+            "step, by schedule",
+            boundaries=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8,
+                        0.9),
+            tag_keys=("stage", "schedule"),
+        ),
+        # -- channels (core/channels.py) --
+        "channel_write_blocks": Counter(
+            "rt_channel_write_blocks_total",
+            "channel writes that blocked or bounced on a full ring / "
+            "mailbox, by transport",
+            tag_keys=("transport",),
+        ),
         # -- host collectives (collective/collective.py, collective/p2p.py) --
         "collective_bytes_sent": Counter(
             "rt_collective_bytes_sent_total",

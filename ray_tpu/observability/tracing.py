@@ -9,6 +9,25 @@ per worker (CoreWorker._task_events); ``state.timeline()`` joins them by
 task_id into Chrome-trace flow events across pids and
 ``state.task_summary()`` turns them into queue-wait / exec percentiles.
 
+On top of the task lifecycle, the same ring carries:
+
+- request spans (``"type": "request"``) — one per component a serve
+  request crosses (proxy / router / replica / engine), all sharing the
+  trace id minted at HTTP ingress (``x-rt-trace-id``), joined by
+  ``state.timeline()`` into one cross-pid flow and rolled up by
+  ``state.request_summary()``;
+- pipeline slices (``"type": "pipeline"``) — per-stage fwd / bwd / idle
+  slices from the compiled-pipeline exec loop, plus a per-step summary
+  carrying the computed bubble fraction;
+- collective spans (``"type": "collective"``) — one per host collective
+  op, so the bytes counters in core_metrics get a timeline counterpart.
+
+Timestamps: every stamp uses ``now_us()`` — a per-process wall-clock
+anchor recorded ONCE at import plus a monotonic delta — so intra-run
+ordering (and cross-pid joins within one run) survives NTP steps
+mid-run. Different processes may disagree by their boot-time clock skew,
+but no process's stamps ever jump backwards.
+
 Hot-path contract: callers guard with the module-level ``ENABLED`` flag
 (``if tracing.ENABLED: ...``) so ``RT_TRACE_EVENTS=0`` reduces every
 stamp site to one attribute check — no dict building, no time syscall.
@@ -20,6 +39,7 @@ from __future__ import annotations
 
 import os
 import time
+import uuid
 from typing import Any, Dict, Optional
 
 from ray_tpu.utils.config import config
@@ -32,11 +52,42 @@ SUBMITTED = "submitted"
 LEASE_GRANTED = "lease_granted"
 DISPATCHED = "dispatched"
 
+# Request span components, in request order. The proxy mints the trace
+# id; every downstream component reads it from the request headers under
+# TRACE_HEADER and stamps its own span.
+TRACE_HEADER = "x-rt-trace-id"
+PROXY = "proxy"
+ROUTER = "router"
+REPLICA = "replica"
+ENGINE = "engine"
+
+# Wall-clock anchor: recorded once per process so every later stamp is
+# anchor + monotonic delta. An NTP step after import cannot reorder this
+# process's events.
+_WALL_ANCHOR = time.time() - time.monotonic()
+
+
+def now_us() -> int:
+    """Microsecond timestamp on the per-process monotonic-anchored
+    wall clock."""
+    return int((_WALL_ANCHOR + time.monotonic()) * 1e6)
+
+
+def mono_us(t_monotonic: float) -> int:
+    """Convert a ``time.monotonic()`` reading already taken by the
+    caller onto the same anchored microsecond clock as ``now_us()``."""
+    return int((_WALL_ANCHOR + t_monotonic) * 1e6)
+
 
 def set_enabled(on: bool) -> None:
     global ENABLED
     ENABLED = bool(on)
     config.set("trace_events", bool(on))
+
+
+def new_trace_id() -> str:
+    """Mint a trace id at HTTP ingress (proxy)."""
+    return uuid.uuid4().hex[:16]
 
 
 def lifecycle_event(
@@ -53,10 +104,102 @@ def lifecycle_event(
         "phase": phase,
         "task_id": task_id,
         "name": name,
-        "ts_us": int(time.time() * 1e6),
+        "ts_us": now_us(),
         "worker": worker_address,
         "pid": os.getpid(),
     }
     if target is not None:
         evt["target"] = target
     return evt
+
+
+def request_span(
+    trace_id: str,
+    component: str,
+    deployment: str,
+    ts_us: int,
+    dur_us: int,
+    worker_address: str = "",
+    **extra: Any,
+) -> Dict[str, Any]:
+    """Build one request span (proxy/router/replica/engine leg of a
+    serve request). ``ts_us`` comes from ``now_us()`` taken at span
+    start; extras (e.g. queue_us, status) ride along untyped."""
+    evt = {
+        "type": "request",
+        "trace_id": trace_id,
+        "component": component,
+        "deployment": deployment,
+        "ts_us": ts_us,
+        "dur_us": dur_us,
+        "worker": worker_address,
+        "pid": os.getpid(),
+    }
+    if extra:
+        evt.update(extra)
+    return evt
+
+
+def pipeline_slice(
+    stage: int,
+    kind: str,
+    ts_us: int,
+    dur_us: int,
+    step: int,
+    microbatch: Optional[int] = None,
+    worker_address: str = "",
+    **extra: Any,
+) -> Dict[str, Any]:
+    """Build one compiled-pipeline stage slice. ``kind`` is one of
+    "fwd" / "bwd" / "idle" / "step" (the per-step summary, which carries
+    bubble_frac and schedule in extras)."""
+    evt = {
+        "type": "pipeline",
+        "stage": stage,
+        "kind": kind,
+        "ts_us": ts_us,
+        "dur_us": dur_us,
+        "step": step,
+        "worker": worker_address,
+        "pid": os.getpid(),
+    }
+    if microbatch is not None:
+        evt["microbatch"] = microbatch
+    if extra:
+        evt.update(extra)
+    return evt
+
+
+def collective_span(
+    op: str,
+    ts_us: int,
+    dur_us: int,
+    nbytes: int = 0,
+    worker_address: str = "",
+    **extra: Any,
+) -> Dict[str, Any]:
+    """Build one host-collective op span for the timeline (the byte and
+    latency *metrics* are core_metrics' job; this is the trace slice)."""
+    evt = {
+        "type": "collective",
+        "op": op,
+        "ts_us": ts_us,
+        "dur_us": dur_us,
+        "nbytes": nbytes,
+        "worker": worker_address,
+        "pid": os.getpid(),
+    }
+    if extra:
+        evt.update(extra)
+    return evt
+
+
+def emit(evt: Dict[str, Any]) -> None:
+    """Append a pre-built event to this process's worker event ring, if
+    a worker exists. Import-at-use keeps the utils-only import
+    discipline for module import time."""
+    from ray_tpu.core import worker as _worker_mod
+
+    w = _worker_mod.global_worker_or_none()
+    if w is not None:
+        w._append_task_event(evt)

@@ -48,6 +48,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import ray_tpu
+from ray_tpu.observability import core_metrics, tracing
 from ray_tpu.utils import serialization
 from ray_tpu.utils.config import config
 
@@ -348,14 +349,21 @@ def _stage_exec_loop(instance, plan_blob: bytes) -> int:
                     pass
             continue
         _, schedule, n_mb, lr = command
+        # per-step observability: input-channel wait counts as idle,
+        # compute+output-write as busy; bubble fraction = idle/(idle+busy)
+        obs = tracing.ENABLED or core_metrics.ENABLED
+        idle_us = busy_us = 0
+        step_t0 = tracing.now_us() if obs else 0
         try:
             for op, k in _schedule_ops(schedule, n_stages, idx, n_mb):
+                t0 = tracing.now_us() if obs else 0
                 if op == "F":
                     x = fwd_in.read(timeout_s=op_t)
                     if _is_stop(x):
                         stopping = True
                         break
                     x = serialization.unpack(x)
+                    t1 = tracing.now_us() if obs else 0
                     if last:
                         target = tgt_in.read_value(timeout_s=op_t)
                         loss_out.write_value(
@@ -368,17 +376,50 @@ def _stage_exec_loop(instance, plan_blob: bytes) -> int:
                         )
                 else:
                     if last:
+                        t1 = t0
                         g = instance.backward_from_loss(k)
                     else:
-                        g = instance.backward(
-                            k, bwd_in.read_value(timeout_s=op_t)
-                        )
+                        g_in = bwd_in.read_value(timeout_s=op_t)
+                        t1 = tracing.now_us() if obs else 0
+                        g = instance.backward(k, g_in)
                     if bwd_out is not None:
                         bwd_out.write_value(g, timeout_s=op_t)
+                if obs:
+                    t2 = tracing.now_us()
+                    idle_us += t1 - t0
+                    busy_us += t2 - t1
+                    if tracing.ENABLED:
+                        if t1 > t0:
+                            tracing.emit(tracing.pipeline_slice(
+                                idx, "idle", t0, t1 - t0, steps,
+                                microbatch=k,
+                            ))
+                        tracing.emit(tracing.pipeline_slice(
+                            idx, "fwd" if op == "F" else "bwd", t1,
+                            t2 - t1, steps, microbatch=k,
+                            schedule=schedule,
+                        ))
             if stopping:
                 break
             instance.apply(lr)
             ack.write_value(("ok", n_mb), timeout_s=op_t)
+            if obs:
+                wall_us = max(tracing.now_us() - step_t0, 1)
+                bubble = idle_us / max(idle_us + busy_us, 1)
+                if tracing.ENABLED:
+                    tracing.emit(tracing.pipeline_slice(
+                        idx, "step", step_t0, wall_us, steps,
+                        bubble_frac=bubble, schedule=schedule,
+                        n_microbatches=n_mb,
+                    ))
+                if core_metrics.ENABLED:
+                    core_metrics.pipeline_stage_busy_s.observe(
+                        busy_us / 1e6, tags={"stage": str(idx)}
+                    )
+                    core_metrics.pipeline_bubble_fraction.observe(
+                        bubble, tags={"stage": str(idx),
+                                      "schedule": schedule}
+                    )
             steps += 1
         except Exception as e:  # noqa: BLE001 — ship to the driver
             instance.reset_step()

@@ -21,11 +21,13 @@ machinery, not the text quality, is the parity surface.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import serve
+from ray_tpu.observability import core_metrics, tracing
 
 
 class LLMConfig:
@@ -56,7 +58,8 @@ class LLMConfig:
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "event", "result",
-                 "error", "token_q", "cancelled")
+                 "error", "token_q", "cancelled", "trace_id", "t_enqueue",
+                 "t0_us")
 
     def __init__(self, prompt, max_new, temperature, stream=False):
         self.prompt = prompt
@@ -65,6 +68,12 @@ class _Request:
         self.event = threading.Event()
         self.result: Optional[List[int]] = None
         self.error: Optional[BaseException] = None
+        # observability (set at enqueue only when the switches are on):
+        # trace id propagated from the proxy, wall/monotonic enqueue
+        # stamps for the engine span and the TTFT histogram
+        self.trace_id: Optional[str] = None
+        self.t_enqueue: Optional[float] = None
+        self.t0_us = 0
         # set when the consumer abandoned the request (client disconnect
         # mid-stream): the engine frees the KV slot at the next round
         # instead of decoding to max_new for nobody
@@ -81,13 +90,14 @@ class _Request:
 class _Slot:
     """One occupied KV-cache row: the request it serves + its cursor."""
 
-    __slots__ = ("req", "length", "produced", "last_token")
+    __slots__ = ("req", "length", "produced", "last_token", "t_last")
 
     def __init__(self, req: _Request, length: int, first_token: int):
         self.req = req
         self.length = length          # tokens currently in the cache row
         self.produced = [first_token]
         self.last_token = first_token
+        self.t_last: Optional[float] = None  # last token delivery stamp
 
 
 class LLMServer:
@@ -116,6 +126,9 @@ class LLMServer:
         self._total_batches = 0
         self._max_batch_seen = 0
         self._occupied = 0  # KV slots held after the last engine round
+        # per-process gauge label (the cluster merge keeps the latest
+        # value PER SERIES; distinct tags keep every engine process)
+        self._node_tag = f"pid{os.getpid()}"
         self._stop = threading.Event()
         if config.engine == "kv":
             target = self._engine_loop_kv
@@ -128,7 +141,10 @@ class LLMServer:
     # -- request path ---------------------------------------------------
 
     def _parse(self, request: Any) -> "_Request":
+        trace_id = None
         if hasattr(request, "json"):  # HTTP proxy path
+            if tracing.ENABLED:
+                trace_id = request.headers.get(tracing.TRACE_HEADER)
             body = request.json()
             stream = (
                 bool(body.get("stream"))
@@ -137,13 +153,17 @@ class LLMServer:
             request = body
         else:
             stream = bool(request.get("stream"))
+            if tracing.ENABLED:
+                trace_id = request.get("trace_id")
         prompt = list(request.get("prompt_tokens") or [0])
         max_new = min(
             int(request.get("max_new_tokens", 16)),
             self.cfg.max_new_tokens_cap,
         )
         temperature = float(request.get("temperature", 0.0))
-        return _Request(prompt, max_new, temperature, stream=stream)
+        req = _Request(prompt, max_new, temperature, stream=stream)
+        req.trace_id = trace_id
+        return req
 
     def __call__(self, request: Any):
         req = self._parse(request)
@@ -151,6 +171,10 @@ class LLMServer:
             # validate BEFORE enqueue: the engine would otherwise decode a
             # request whose caller already got the ValueError
             raise ValueError("stream=True requires the kv engine")
+        if core_metrics.ENABLED or tracing.ENABLED:
+            req.t_enqueue = time.monotonic()
+            if tracing.ENABLED and req.trace_id:
+                req.t0_us = tracing.now_us()
         with self._lock:
             self._queue.append(req)
         self._work.set()
@@ -230,6 +254,15 @@ class LLMServer:
             self._batch_sizes.append(occupancy)
             self._total_batches += 1
             self._max_batch_seen = max(self._max_batch_seen, occupancy)
+            queued = len(self._queue)
+        if core_metrics.ENABLED:
+            dep = self.cfg.model_id
+            core_metrics.serve_batch_fill.observe(
+                occupancy, tags={"deployment": dep}
+            )
+            ntags = {"deployment": dep, "node": self._node_tag}
+            core_metrics.serve_kv_slots_occupied.set(occupancy, tags=ntags)
+            core_metrics.serve_queued_requests.set(queued, tags=ntags)
 
     # -- KV engine (continuous batching over cache slots) ---------------
 
@@ -285,7 +318,17 @@ class LLMServer:
                 # is already set; fail_inflight won't see it in slots)
                 raise
             first = int(self._sample_one(logits, req.temperature))
-            slots[i] = _Slot(req, len(prompt), first)
+            slot = _Slot(req, len(prompt), first)
+            slots[i] = slot
+            if core_metrics.ENABLED:
+                now = time.monotonic()
+                slot.t_last = now
+                dep_tags = {"deployment": self.cfg.model_id}
+                if req.t_enqueue is not None:
+                    core_metrics.serve_ttft_s.observe(
+                        now - req.t_enqueue, tags=dep_tags
+                    )
+                core_metrics.serve_tokens_generated.inc(tags=dep_tags)
             if req.token_q is not None and req.max_new >= 1:
                 # zero-token completions must not leak the sampled-but-
                 # unrequested first token into the stream
@@ -299,6 +342,12 @@ class LLMServer:
             slot = slots[i]
             slots[i] = None
             slot.req.result = slot.produced[: slot.req.max_new]
+            if tracing.ENABLED and slot.req.trace_id and slot.req.t0_us:
+                tracing.emit(tracing.request_span(
+                    slot.req.trace_id, tracing.ENGINE, self.cfg.model_id,
+                    slot.req.t0_us, tracing.now_us() - slot.req.t0_us,
+                    tokens=len(slot.req.result),
+                ))
             slot.req.event.set()
             if slot.req.token_q is not None:
                 slot.req.token_q.put(None)  # end of stream
@@ -400,6 +449,25 @@ class LLMServer:
                 )
                 dev_state = (nxt_dev, d_len, d_temps, d_greedy)
                 toks = np.asarray(nxt_dev)[None]  # [1, S]
+            if core_metrics.ENABLED:
+                # every active slot receives exactly toks.shape[0] tokens
+                # this round (the chunk is bounded by the minimum
+                # remaining budget across active slots)
+                now = time.monotonic()
+                n_new = toks.shape[0]
+                dep_tags = {"deployment": self.cfg.model_id}
+                core_metrics.serve_tokens_generated.inc(
+                    n_new * len(active), tags=dep_tags
+                )
+                for i in active:
+                    s = slots[i]
+                    if s is None:
+                        continue
+                    if s.t_last is not None:
+                        core_metrics.serve_inter_token_s.observe(
+                            (now - s.t_last) / n_new, tags=dep_tags
+                        )
+                    s.t_last = now
             changed = False
             for k in range(toks.shape[0]):
                 for i in active:

@@ -78,13 +78,26 @@ class _Multiplexer:
                 self.loader(instance, model_id) if instance is not None
                 else self.loader(model_id)
             )
+            from ray_tpu.observability import core_metrics
+
+            if core_metrics.ENABLED:
+                core_metrics.serve_multiplex_loads.inc(
+                    tags={"model": model_id}
+                )
             with self._lock:
                 self._models[model_id] = model
                 self._models.move_to_end(model_id)
                 evicted = []
+                evicted_ids = []
                 while len(self._models) > self.max_models:
-                    _, old = self._models.popitem(last=False)  # LRU out
+                    old_id, old = self._models.popitem(last=False)  # LRU out
                     evicted.append(old)
+                    evicted_ids.append(old_id)
+            if core_metrics.ENABLED:
+                for old_id in evicted_ids:
+                    core_metrics.serve_multiplex_evictions.inc(
+                        tags={"model": old_id}
+                    )
             for old in evicted:
                 # reference calls __del__/model cleanup hooks if present
                 unload = getattr(old, "unload", None)

@@ -37,6 +37,7 @@ from ray_tpu.serve.openai.protocol import (
     UsageInfo,
 )
 from ray_tpu.serve.openai import tokenizer as tokenizer_mod
+from ray_tpu.observability import tracing
 
 
 def _normalize_models(models) -> Dict[str, Any]:
@@ -111,11 +112,15 @@ class OpenAIServer:
             return e.status, "application/json", e.body()
 
     def _route(self, request: Any):
+        trace_id = None
         if isinstance(request, dict):  # handle.remote() / test calls
             body = request
             path = request.get("__path__", "/v1/completions")
         else:
             path = getattr(request, "path", "") or ""
+            if tracing.ENABLED:
+                trace_id = (getattr(request, "headers", None)
+                            or {}).get(tracing.TRACE_HEADER)
             if path.endswith("/models"):
                 return self.list_models()
             try:
@@ -123,9 +128,9 @@ class OpenAIServer:
             except ValueError:
                 raise OpenAIError("request body is not valid JSON") from None
         if path.endswith("/chat/completions"):
-            return self.chat_completion(body)
+            return self.chat_completion(body, trace_id=trace_id)
         if path.endswith("/completions"):
-            return self.completion(body)
+            return self.completion(body, trace_id=trace_id)
         if path.endswith("/models"):
             return self.list_models()
         raise OpenAIError(f"no OpenAI route for {path!r}", status=404,
@@ -145,13 +150,14 @@ class OpenAIServer:
         yield b"data: " + e.body() + b"\n\n"
         yield protocol.SSE_DONE
 
-    def completion(self, body: Any):
+    def completion(self, body: Any, trace_id: Optional[str] = None):
         try:
             req = CompletionRequest.from_body(body)
             tok = self._tokenizer_for(req.model)
             prompt_tokens = tok.encode(req.prompt)
             engine, eng_req = self._engine_request(
                 req.model, prompt_tokens, req.max_tokens, req.temperature,
+                trace_id=trace_id,
             )
         except OpenAIError as e:
             if isinstance(body, dict) and body.get("stream"):
@@ -172,13 +178,14 @@ class OpenAIServer:
         )
         return 200, "application/json", resp.json_bytes()
 
-    def chat_completion(self, body: Any):
+    def chat_completion(self, body: Any, trace_id: Optional[str] = None):
         try:
             req = ChatCompletionRequest.from_body(body)
             tok = self._tokenizer_for(req.model)
             prompt_tokens = tokenizer_mod.encode_chat(req.messages, tok)
             engine, eng_req = self._engine_request(
                 req.model, prompt_tokens, req.max_tokens, req.temperature,
+                trace_id=trace_id,
             )
         except OpenAIError as e:
             if isinstance(body, dict) and body.get("stream"):
@@ -197,7 +204,8 @@ class OpenAIServer:
         return 200, "application/json", resp.json_bytes()
 
     def _engine_request(self, model: str, prompt_tokens: List[int],
-                        max_tokens: int, temperature: float):
+                        max_tokens: int, temperature: float,
+                        trace_id: Optional[str] = None):
         engine = self._engines.get(model)
         vocab = engine.model_cfg.vocab_size
         eng_req = {
@@ -207,6 +215,10 @@ class OpenAIServer:
             "max_new_tokens": int(max_tokens),
             "temperature": float(temperature),
         }
+        if trace_id is not None:
+            # rides the engine-request dict: the proxy-minted trace id
+            # reaches the engine span without a header-bearing object
+            eng_req["trace_id"] = trace_id
         return engine, eng_req
 
     # -- SSE streaming ---------------------------------------------------

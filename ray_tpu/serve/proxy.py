@@ -89,10 +89,27 @@ class ServeProxy:
                 headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
             )
             session_key = None
+        from ray_tpu.observability import tracing
+
+        trace = None
+        if tracing.ENABLED:
+            trace_id = (headers.get(tracing.TRACE_HEADER)
+                        or tracing.new_trace_id())
+            headers[tracing.TRACE_HEADER] = trace_id
+            trace = (trace_id, None, tracing.now_us())
         picked = self._router.try_pick_nowait(path, model_id, session_key)
         if picked is None:
             return None
         deployment, rid, handle = picked
+        if trace is not None:
+            # fill in the deployment the pick resolved; stamp the pick
+            # itself as the (sub-ms) router leg of this trace
+            trace = (trace[0], deployment, trace[2])
+            if tracing.ENABLED:
+                tracing.emit(tracing.request_span(
+                    trace[0], tracing.ROUTER, deployment, trace[2],
+                    tracing.now_us() - trace[2], replica=rid,
+                ))
         from ray_tpu.core import worker as worker_mod
 
         w = worker_mod.global_worker()
@@ -111,9 +128,38 @@ class ServeProxy:
         except RpcError:
             self._router.request_finished(rid)
             return None  # connection just dropped: pool path re-routes
-        return self._await_direct(pending, rid, openai=probe is not None)
+        return self._await_direct(pending, rid, openai=probe is not None,
+                                  trace=trace)
 
-    async def _await_direct(self, pending, rid: str, openai: bool):
+    def _trace_begin(self, headers, deployment):
+        """Mint (or adopt) the trace id, inject it into the request
+        headers, and return (trace_id, deployment, t0_us) — or None when
+        tracing is off, so downstream stamp sites short-circuit."""
+        from ray_tpu.observability import tracing
+
+        if not tracing.ENABLED:
+            return None
+        trace_id = (headers.get(tracing.TRACE_HEADER)
+                    or tracing.new_trace_id())
+        headers[tracing.TRACE_HEADER] = trace_id
+        return (trace_id, deployment, tracing.now_us())
+
+    def _trace_end(self, trace, status: int = 200) -> None:
+        """Stamp the proxy (end-to-end) span for a request begun with
+        _trace_begin."""
+        if trace is None:
+            return
+        from ray_tpu.observability import tracing
+
+        if tracing.ENABLED:
+            trace_id, deployment, t0 = trace
+            tracing.emit(tracing.request_span(
+                trace_id, tracing.PROXY, deployment or "?",
+                t0, tracing.now_us() - t0, status=status,
+            ))
+
+    async def _await_direct(self, pending, rid: str, openai: bool,
+                            trace=None):
         from ray_tpu.serve.router import Router
         from ray_tpu.utils.rpc import RemoteError
 
@@ -126,10 +172,12 @@ class ServeProxy:
             )
 
         pending.add_done_callback(_deliver)
+        status = None  # None at exit = fell back to pool: no proxy span
         try:
             try:
                 p = await asyncio.wait_for(fut, timeout=120)
             except asyncio.TimeoutError:
+                status = 503
                 return 503, "application/json", (
                     oai.error_body("request timed out",
                                    err_type="overloaded_error")
@@ -140,6 +188,7 @@ class ServeProxy:
                     # the request EXECUTED and raised: a real 500, never
                     # re-dispatched (double execution)
                     msg = f"RemoteError: {p.payload}"
+                    status = 500
                     return 500, "application/json", (
                         oai.error_body(msg, err_type="internal_error")
                         if openai else json.dumps({"error": msg}).encode()
@@ -152,17 +201,23 @@ class ServeProxy:
                 raise FallbackToPool  # mid-restart: pool path re-routes
             result = Router._unwrap_direct(reply[1])
             if openai:
-                return oai.split_http_result(result)
+                out = oai.split_http_result(result)
+                status = out[0]
+                return out
+            status = 200
             if isinstance(result, (bytes, bytearray, memoryview)):
                 return 200, "application/json", result
             if (
                 isinstance(result, tuple) and len(result) == 3
                 and isinstance(result[0], int)
             ):
+                status = result[0]
                 return result
             return 200, "application/json", json.dumps(result).encode()
         finally:
             self._router.request_finished(rid)
+            if status is not None:
+                self._trace_end(trace, status)
 
     # -- request path (runs on the server's executor pool) --------------
 
@@ -195,6 +250,7 @@ class ServeProxy:
             return 404, "application/json", json.dumps(
                 {"error": f"no route for {path}"}
             ).encode()
+        trace = self._trace_begin(headers, deployment)
         request = Request(method, path, body, headers, query)
 
         def gen():
@@ -211,6 +267,8 @@ class ServeProxy:
                 yield json.dumps(
                     {"error": f"{type(e).__name__}: {e}"}
                 ).encode() + b"\n"
+            finally:
+                self._trace_end(trace, 200)
 
         return gen()
 
@@ -226,29 +284,37 @@ class ServeProxy:
                 f"no route for {path}", err_type="invalid_request_error",
                 code="route_not_found",
             )
+        trace = self._trace_begin(headers, deployment)
         request = Request(method, path, body, headers, query)
         if probe.stream:
-            return self._openai_stream(deployment, request, probe)
+            return self._openai_stream(deployment, request, probe, trace)
         try:
             result = self._router.call_direct(
                 deployment, request, timeout_s=300,
                 model_id=probe.model, session_key=probe.session_key,
             )
         except (TimeoutError, RpcTimeout) as e:
+            self._trace_end(trace, 503)
             return 503, "application/json", oai.error_body(
                 str(e), err_type="overloaded_error"
             )
         except Exception as e:  # noqa: BLE001
+            self._trace_end(trace, 500)
             return 500, "application/json", oai.error_body(
                 f"{type(e).__name__}: {e}", err_type="internal_error"
             )
-        return oai.split_http_result(result)
+        out = oai.split_http_result(result)
+        self._trace_end(trace, out[0])
+        return out
 
     def _openai_stream(self, deployment: str, request: Request,
-                       probe: "oai.Probe"):
+                       probe: "oai.Probe", trace=None):
         """SSE response: each yielded ``data: {...}\\n\\n`` event is one
         chunk; closing the connection closes this generator, which
-        cancels the replica-side stream and frees the engine's KV slot."""
+        cancels the replica-side stream and frees the engine's KV slot.
+        The proxy span closes when the generator does, so its duration
+        covers the whole stream (the e2e number request_summary rolls
+        up)."""
 
         def gen():
             try:
@@ -261,6 +327,8 @@ class ServeProxy:
                     )
             except Exception as e:  # noqa: BLE001 — mid-stream trailer
                 yield oai.sse_error(f"{type(e).__name__}: {e}")
+            finally:
+                self._trace_end(trace, 200)
 
         return 200, oai.SSE_CONTENT_TYPE, gen()
 
@@ -285,17 +353,21 @@ class ServeProxy:
         model_id: Optional[str] = (
             headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
         )
+        trace = self._trace_begin(headers, deployment)
         request = Request(method, path, body, headers, query)
         result = self._router.call_direct(
             deployment, request, timeout_s=120, model_id=model_id
         )
         if isinstance(result, (bytes, bytearray, memoryview)):
+            self._trace_end(trace, 200)
             return 200, "application/json", result
         if (
             isinstance(result, tuple) and len(result) == 3
             and isinstance(result[0], int)
         ):
+            self._trace_end(trace, result[0])
             return result
+        self._trace_end(trace, 200)
         return 200, "application/json", json.dumps(result).encode()
 
     def address(self) -> str:

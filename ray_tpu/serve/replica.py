@@ -58,7 +58,28 @@ class ServeReplica:
         self._lock = threading.Lock()
         self._started = time.time()
 
+    def _trace_id_of(self, payload: Any) -> Optional[str]:
+        from ray_tpu.observability import tracing
+
+        headers = getattr(payload, "headers", None)
+        if headers:
+            return headers.get(tracing.TRACE_HEADER)
+        return None
+
+    def _stamp(self, trace_id: Optional[str], t0_us: int) -> None:
+        from ray_tpu.observability import tracing
+
+        if trace_id and tracing.ENABLED:
+            tracing.emit(tracing.request_span(
+                trace_id, tracing.REPLICA, self.deployment_name,
+                t0_us, tracing.now_us() - t0_us,
+            ))
+
     def handle_request(self, payload: Any, *, method: Optional[str] = None):
+        from ray_tpu.observability import tracing
+
+        trace_id = self._trace_id_of(payload) if tracing.ENABLED else None
+        t0_us = tracing.now_us() if trace_id else 0
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -70,6 +91,7 @@ class ServeReplica:
         finally:
             with self._lock:
                 self._ongoing -= 1
+            self._stamp(trace_id, t0_us)
 
     def handle_request_direct(self, payload: Any, *,
                               method: Optional[str] = None):
@@ -106,6 +128,10 @@ class ServeReplica:
         item reaches the caller as it is produced (core streaming
         generators; parity: reference streaming deployment responses
         through the proxy's chunked transfer)."""
+        from ray_tpu.observability import tracing
+
+        trace_id = self._trace_id_of(payload) if tracing.ENABLED else None
+        t0_us = tracing.now_us() if trace_id else 0
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -125,6 +151,7 @@ class ServeReplica:
         finally:
             with self._lock:
                 self._ongoing -= 1
+            self._stamp(trace_id, t0_us)
 
     def health(self) -> bool:
         return True

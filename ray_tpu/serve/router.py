@@ -35,10 +35,19 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.core.actor import ActorHandle
-from ray_tpu.observability import core_metrics
+from ray_tpu.observability import core_metrics, tracing
 from ray_tpu.utils.config import config
 
 ROUTE_REFRESH_S = 1.0
+
+
+def _trace_id_of(payload: Any) -> Optional[str]:
+    """Trace id the proxy injected into the request headers, if the
+    payload is header-bearing (serve Request) and tracing stamped one."""
+    headers = getattr(payload, "headers", None)
+    if headers:
+        return headers.get(tracing.TRACE_HEADER)
+    return None
 
 
 class Router:
@@ -267,9 +276,16 @@ class Router:
         disconnected and the proxy closed this generator) cancels the
         replica-side task so the deployment's generator unwinds and the
         LLM engine frees the request's KV slot."""
+        tid = _trace_id_of(payload) if tracing.ENABLED else None
+        t0u = tracing.now_us() if tid else 0
         rid, handle = self.choose_replica(
             deployment, timeout_s, model_id, session_key
         )
+        if tid and tracing.ENABLED:
+            tracing.emit(tracing.request_span(
+                tid, tracing.ROUTER, deployment, t0u,
+                tracing.now_us() - t0u, replica=rid,
+            ))
         gen = None
         exhausted = False
         try:
@@ -314,12 +330,19 @@ class Router:
 
         deadline = time.monotonic() + timeout_s
         last_exc: Optional[BaseException] = None
+        tid = _trace_id_of(payload) if tracing.ENABLED else None
         for _ in range(4):
             remaining = max(0.5, deadline - time.monotonic())
+            t0u = tracing.now_us() if tid else 0
             rid, ref = self.assign(
                 deployment, payload, method, remaining, model_id,
                 session_key,
             )
+            if tid and tracing.ENABLED:
+                tracing.emit(tracing.request_span(
+                    tid, tracing.ROUTER, deployment, t0u,
+                    tracing.now_us() - t0u, replica=rid,
+                ))
             try:
                 return ray_tpu.get(ref, timeout=remaining)
             except (ActorDiedError, ActorUnavailableError) as e:
@@ -361,11 +384,18 @@ class Router:
         w = worker_mod.global_worker()
         deadline = time.monotonic() + timeout_s
         last_exc: Optional[BaseException] = None
+        tid = _trace_id_of(payload) if tracing.ENABLED else None
         for _ in range(4):
             remaining = max(0.5, deadline - time.monotonic())
+            t0u = tracing.now_us() if tid else 0
             rid, handle = self.choose_replica(
                 deployment, remaining, model_id, session_key
             )
+            if tid and tracing.ENABLED:
+                tracing.emit(tracing.request_span(
+                    tid, tracing.ROUTER, deployment, t0u,
+                    tracing.now_us() - t0u, replica=rid,
+                ))
             addr = None
             try:
                 addr = w._resolve_actor_address(

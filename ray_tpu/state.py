@@ -219,8 +219,55 @@ def timeline(address: Optional[str] = None,
     exec_slices: Dict[str, Dict[str, Any]] = {}
     submits: Dict[str, Dict[str, Any]] = {}
     dispatches: Dict[str, Dict[str, Any]] = {}
+    request_spans: Dict[str, List[Dict[str, Any]]] = {}
     for e in events:
-        if e.get("type") == "lifecycle":
+        etype = e.get("type")
+        if etype == "request":
+            # serve request leg: one slice per component, joined below
+            # into a cross-pid flow by trace id
+            args = {"trace_id": e["trace_id"]}
+            for k in ("queue_us", "status", "model"):
+                if k in e:
+                    args[k] = e[k]
+            trace.append({
+                "name": f"{e['component']}:{e.get('deployment', '')}",
+                "cat": "request",
+                "ph": "X",
+                "ts": e["ts_us"],
+                "dur": max(int(e.get("dur_us", 0)), 1),
+                "pid": e.get("worker") or e.get("pid", 0),
+                "tid": e.get("pid", 0),
+                "args": args,
+            })
+            request_spans.setdefault(e["trace_id"], []).append(e)
+            continue
+        if etype == "pipeline":
+            trace.append({
+                "name": f"stage{e['stage']}:{e['kind']}",
+                "cat": "pipeline",
+                "ph": "X",
+                "ts": e["ts_us"],
+                "dur": max(int(e.get("dur_us", 0)), 1),
+                "pid": e.get("worker") or e.get("pid", 0),
+                "tid": e.get("pid", 0),
+                "args": {k: e[k] for k in
+                         ("step", "microbatch", "bubble_frac", "schedule")
+                         if k in e},
+            })
+            continue
+        if etype == "collective":
+            trace.append({
+                "name": f"collective:{e['op']}",
+                "cat": "collective",
+                "ph": "X",
+                "ts": e["ts_us"],
+                "dur": max(int(e.get("dur_us", 0)), 1),
+                "pid": e.get("worker") or e.get("pid", 0),
+                "tid": e.get("pid", 0),
+                "args": {"nbytes": e.get("nbytes", 0)},
+            })
+            continue
+        if etype == "lifecycle":
             if e["phase"] == "submitted":
                 submits[e["task_id"]] = e
             elif e["phase"] == "dispatched":
@@ -283,6 +330,24 @@ def timeline(address: Optional[str] = None,
             **flow, "ph": "f", "bp": "e", "ts": exec_e["ts_us"],
             "pid": exec_e["worker"], "tid": exec_e.get("pid", 0),
         })
+    # request flow: one arrow chain per trace id, hop by hop through the
+    # components in time order (proxy → router → replica → engine),
+    # binding each step to the enclosing component slice
+    for trace_id, spans in request_spans.items():
+        if len(spans) < 2:
+            continue
+        spans = sorted(spans, key=lambda s: s["ts_us"])
+        flow = {"name": "request", "cat": "request_flow", "id": trace_id}
+        for i, s in enumerate(spans):
+            ph = "s" if i == 0 else ("f" if i == len(spans) - 1 else "t")
+            step = {
+                **flow, "ph": ph, "ts": s["ts_us"],
+                "pid": s.get("worker") or s.get("pid", 0),
+                "tid": s.get("pid", 0),
+            }
+            if ph == "f":
+                step["bp"] = "e"
+            trace.append(step)
     if out_path:
         with open(out_path, "w") as f:
             json.dump(trace, f)
@@ -303,6 +368,18 @@ def _percentiles(values: List[float]) -> Dict[str, float]:
     }
 
 
+def _latency_entry(splits: Dict[str, List[float]],
+                   count_key: str) -> Dict[str, Any]:
+    """Shared rollup for task_summary/request_summary: one count (taken
+    from count_key's split — the one every sample contributes to) plus
+    p50/p95/p99/mean/max for each non-empty split."""
+    entry: Dict[str, Any] = {"count": len(splits.get(count_key, ()))}
+    for key, vals in splits.items():
+        if vals:
+            entry[key] = _percentiles(vals)
+    return entry
+
+
 def task_summary(address: Optional[str] = None) -> Dict[str, Any]:
     """Per-task-name latency summary joined across processes: queue wait
     (owner "submitted" instant → executor slice start) and execution
@@ -315,8 +392,8 @@ def task_summary(address: Optional[str] = None) -> Dict[str, Any]:
             submits[e["task_id"]] = e["ts_us"]
     per_name: Dict[str, Dict[str, List[float]]] = {}
     for e in events:
-        if e.get("type") == "lifecycle":
-            continue
+        if e.get("type") is not None:
+            continue  # lifecycle/request/pipeline/collective events
         rec = per_name.setdefault(
             e["name"], {"queue_wait_s": [], "exec_s": []}
         )
@@ -328,12 +405,36 @@ def task_summary(address: Optional[str] = None) -> Dict[str, Any]:
             rec["queue_wait_s"].append(max(e["ts_us"] - sub_ts, 0) / 1e6)
     tasks = {}
     for name, rec in sorted(per_name.items()):
-        entry: Dict[str, Any] = {"count": len(rec["exec_s"])}
-        entry["exec_s"] = _percentiles(rec["exec_s"])
-        if rec["queue_wait_s"]:
-            entry["queue_wait_s"] = _percentiles(rec["queue_wait_s"])
-        tasks[name] = entry
+        tasks[name] = _latency_entry(rec, "exec_s")
     return {"tasks": tasks, "events_dropped": dropped}
+
+
+def request_summary(address: Optional[str] = None) -> Dict[str, Any]:
+    """Per-deployment serve-request latency summary from the request
+    spans stamped along the proxy → router → replica → engine path:
+    end-to-end (proxy span), queue (router span: pick + wait for a
+    replica assignment), and execution (replica span), each as
+    p50/p95/p99/mean/max seconds."""
+    events, dropped = _collect_task_events(address)
+    per_dep: Dict[str, Dict[str, List[float]]] = {}
+    for e in events:
+        if e.get("type") != "request":
+            continue
+        rec = per_dep.setdefault(e.get("deployment") or "?", {
+            "e2e_s": [], "queue_s": [], "exec_s": [],
+        })
+        dur_s = e.get("dur_us", 0) / 1e6
+        comp = e.get("component")
+        if comp == "proxy":
+            rec["e2e_s"].append(dur_s)
+        elif comp == "router":
+            rec["queue_s"].append(dur_s)
+        elif comp == "replica":
+            rec["exec_s"].append(dur_s)
+    deployments = {}
+    for dep, rec in sorted(per_dep.items()):
+        deployments[dep] = _latency_entry(rec, "e2e_s")
+    return {"deployments": deployments, "events_dropped": dropped}
 
 
 def tasks(address: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -355,7 +456,10 @@ def tasks(address: Optional[str] = None) -> List[Dict[str, Any]]:
         })
 
     for e in events:
-        if e.get("type") == "lifecycle":
+        etype = e.get("type")
+        if etype not in (None, "lifecycle"):
+            continue  # request/pipeline/collective spans carry no task_id
+        if etype == "lifecycle":
             if e["phase"] == "lease_granted":
                 continue  # lease churn, not a task transition
             r = rec(e["task_id"])

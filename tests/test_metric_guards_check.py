@@ -1,0 +1,120 @@
+"""Observability guard static check (tier-1): every metric update /
+trace stamp in the package must sit behind the module-level kill switch
+(`if core_metrics.ENABLED:` / `if tracing.ENABLED:`), and the checker
+itself must keep catching each unguarded pattern."""
+
+import os
+import sys
+import textwrap
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+sys.path.insert(0, TOOLS)
+
+from check_metric_guards import (  # noqa: E402
+    check_source, iter_default_files, check_file,
+)
+
+
+def test_package_stamps_are_guarded():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    violations = []
+    for path in iter_default_files(root):
+        violations.extend(check_file(path))
+    assert not violations, "\n".join(violations)
+
+
+def _check(body: str):
+    return check_source(textwrap.dedent(body))
+
+
+def test_flags_unguarded_counter_inc():
+    violations = _check("""
+        def route(dep):
+            core_metrics.serve_router_requests.inc(tags={"deployment": dep})
+    """)
+    assert len(violations) == 1
+    assert "core_metrics.ENABLED" in violations[0]
+
+
+def test_flags_unguarded_emit_and_append():
+    violations = _check("""
+        def stamp(self, evt):
+            tracing.emit(evt)
+            self._append_task_event(evt)
+    """)
+    assert len(violations) == 2
+    assert all("tracing.ENABLED" in v for v in violations)
+
+
+def test_accepts_plain_guard():
+    violations = _check("""
+        def route(dep):
+            if core_metrics.ENABLED:
+                core_metrics.serve_router_requests.inc(
+                    tags={"deployment": dep}
+                )
+    """)
+    assert not violations, violations
+
+
+def test_accepts_compound_and_mixed_guards():
+    violations = _check("""
+        def stamp(self, tid, occupancy):
+            if tid and tracing.ENABLED:
+                tracing.emit({"trace_id": tid})
+            if core_metrics.ENABLED or tracing.ENABLED:
+                if core_metrics.ENABLED:
+                    core_metrics.serve_batch_fill.observe(occupancy)
+                if tracing.ENABLED:
+                    tracing.emit({"fill": occupancy})
+    """)
+    assert not violations, violations
+
+
+def test_accepts_early_return_guard():
+    violations = _check("""
+        def publish(self):
+            if not core_metrics.ENABLED:
+                return
+            core_metrics.object_store_used_bytes.set(self._used)
+    """)
+    assert not violations, violations
+
+
+def test_wrong_module_guard_does_not_satisfy():
+    violations = _check("""
+        def stamp(evt):
+            if core_metrics.ENABLED:
+                tracing.emit(evt)
+    """)
+    assert len(violations) == 1
+    assert "tracing.ENABLED" in violations[0]
+
+
+def test_guard_does_not_leak_to_siblings():
+    violations = _check("""
+        def route(dep):
+            if core_metrics.ENABLED:
+                pass
+            core_metrics.serve_router_requests.inc(tags={"deployment": dep})
+    """)
+    assert len(violations) == 1
+
+
+def test_non_observability_calls_not_flagged():
+    violations = _check("""
+        def other(headers, s):
+            headers.set("x", "y")
+            s.observe(1.0)
+            gauges.inc()
+            tracing.now_us()
+    """)
+    assert not violations, violations
+
+
+def test_honors_opt_out_mark():
+    violations = _check("""
+        def route(dep):
+            core_metrics.serve_router_requests.inc()  # obs: unguarded
+    """)
+    assert not violations, violations
