@@ -130,6 +130,12 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict]) -> str:
     for dep, h in hist_by_tag("rt_serve_batch_fill", "deployment").items():
         if h["count"]:
             row(dep)["batch_fill"] = f"{h['sum'] / h['count']:.1f}"
+    hits = by_tag("rt_serve_prefix_cache_hits_total", "deployment")
+    misses = by_tag("rt_serve_prefix_cache_misses_total", "deployment")
+    for dep in set(hits) | set(misses):
+        total = hits.get(dep, 0.0) + misses.get(dep, 0.0)
+        if total:
+            row(dep)["cache_hit"] = f"{100.0 * hits.get(dep, 0.0) / total:.0f}%"
     for dep, r in rows.items():
         r["qps"] = (
             f"{qps.get(dep, 0.0):.1f}" if qps is not None else "-"
@@ -139,7 +145,8 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict]) -> str:
     out.append(_fmt_table(
         [rows[d] for d in sorted(rows)],
         ["deployment", "reqs", "qps", "ttft_p50_ms", "ttft_p95_ms",
-         "itl_p50_ms", "tokens", "kv_slots", "queued", "batch_fill"],
+         "itl_p50_ms", "tokens", "kv_slots", "queued", "batch_fill",
+         "cache_hit"],
     ))
 
     # -- request summary: e2e / queue / exec percentiles per deployment --

@@ -119,6 +119,97 @@ def prefill(cfg: GPT2Config, params, tokens, length, cache_k, cache_v,
     return logits[: cfg.vocab_size], cache_k, cache_v
 
 
+@partial(jax.jit, donate_argnums=(2, 3))
+def write_prefix(prefix_k, prefix_v, cache_k, cache_v, slot):
+    """Copy precomputed prefix K/V ``[L, C, H, Dh]`` into cache row
+    ``slot`` (positions 0..C-1) — the admission path for a prefix-cache
+    hit or a disaggregated KV import: the slot starts life already
+    holding C tokens of context without running a single prefill flop.
+
+    C must be one of a small set of sizes (block multiples from the
+    prefix pool, pow-2 padded lengths from kv_transfer) so the jit
+    bucket count stays bounded like prefill's P buckets."""
+    ck = jax.lax.dynamic_update_slice(
+        cache_k, prefix_k.astype(cache_k.dtype)[:, None], (0, slot, 0, 0, 0)
+    )
+    cv = jax.lax.dynamic_update_slice(
+        cache_v, prefix_v.astype(cache_v.dtype)[:, None], (0, slot, 0, 0, 0)
+    )
+    return ck, cv
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
+def prefill_extend(cfg: GPT2Config, params, tokens, start, length, cache_k,
+                   cache_v, slot):
+    """Prefill ONLY the uncached tail of a prompt: ``tokens`` [1, P]
+    (right-padded, ``length`` real) are positions start..start+P-1, and
+    cache row ``slot`` already holds K/V for positions 0..start-1
+    (written by :func:`write_prefix`). Writes the tail's K/V at offset
+    ``start``, attends the tail over prefix+tail, and returns the last
+    real tail position's logits [vocab] plus the updated caches.
+
+    The caller guarantees start + P <= T_max (dynamic_update_slice would
+    silently clamp the write offset otherwise)."""
+    dt = cfg.dtype
+    P = tokens.shape[1]
+    T = cache_k.shape[2]
+    pos = start + jnp.arange(P)
+    x = (
+        params["wte"].astype(dt)[tokens]
+        + params["wpe"].astype(dt)[jnp.clip(pos, 0, T - 1)][None]
+    )
+    # tail position start+i may attend every cached position 0..start+i
+    mask = jnp.arange(T)[None] <= pos[:, None]  # [P, T]
+
+    def body(layer_idx, carry):
+        x, ck, cv = carry
+        layer = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, layer_idx, axis=0, keepdims=False
+            ),
+            params["blocks"],
+        )
+        h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        q, k, v = _qkv(h, layer, cfg)  # [1, P, H, Dh]
+        # park the tail's K/V after the prefix (in place on the donated
+        # carry), then attend over the whole row so the tail sees the
+        # cached prefix it never recomputed
+        ck = jax.lax.dynamic_update_slice(
+            ck, k.astype(dt)[None], (layer_idx, slot, start, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, v.astype(dt)[None], (layer_idx, slot, start, 0, 0)
+        )
+        ck_l = jax.lax.dynamic_slice(
+            ck, (layer_idx, slot, 0, 0, 0),
+            (1, 1, T, cfg.n_head, cfg.head_dim),
+        )[:, 0]  # [1, T, H, Dh]
+        cv_l = jax.lax.dynamic_slice(
+            cv, (layer_idx, slot, 0, 0, 0),
+            (1, 1, T, cfg.n_head, cfg.head_dim),
+        )[:, 0]
+        scale = 1.0 / (cfg.head_dim ** 0.5)
+        scores = jnp.einsum("bthn,bshn->bhts", q, ck_l) * scale
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        att = jnp.einsum("bhts,bshn->bthn", probs, cv_l)
+        x = _proj_mlp(x, att, layer, cfg)
+        return x, ck, cv
+
+    x, cache_k, cache_v = jax.lax.fori_loop(
+        0, cfg.n_layer, body, (x, cache_k, cache_v)
+    )
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    last = jax.lax.dynamic_index_in_dim(
+        x[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False
+    )
+    logits = jnp.einsum(
+        "d,vd->v", last.astype(dt), params["wte"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits[: cfg.vocab_size], cache_k, cache_v
+
+
 def _decode_step_impl(cfg: GPT2Config, params, last_tokens, lengths, cache_k,
                       cache_v):
     """One token for every slot: [S] last tokens at positions ``lengths``

@@ -155,6 +155,32 @@ def _build() -> dict:
             boundaries=_BATCH_BOUNDS,
             tag_keys=("deployment",),
         ),
+        "serve_prefix_cache_hits": Counter(
+            "rt_serve_prefix_cache_hits_total",
+            "prompt prefix blocks served from the engine block pool "
+            "instead of being re-prefilled",
+            tag_keys=("deployment",),
+        ),
+        "serve_prefix_cache_misses": Counter(
+            "rt_serve_prefix_cache_misses_total",
+            "prompt prefix blocks that had to be prefilled (not resident)",
+            tag_keys=("deployment",),
+        ),
+        "serve_prefix_cache_evictions": Counter(
+            "rt_serve_prefix_cache_evictions_total",
+            "prefix blocks LRU-evicted from the engine block pool",
+            tag_keys=("deployment",),
+        ),
+        "serve_prefix_blocks_resident": Gauge(
+            "rt_serve_prefix_blocks_resident",
+            "prefix KV blocks currently resident in this engine's pool",
+            tag_keys=("deployment", "node"),
+        ),
+        "serve_kv_transfer_bytes": Counter(
+            "rt_serve_kv_transfer_bytes_total",
+            "KV-cache bytes shipped prefill -> decode over rpc channels",
+            tag_keys=("deployment",),
+        ),
         "serve_multiplex_loads": Counter(
             "rt_serve_multiplex_loads_total",
             "per-model multiplex loads (cold model pulled into a replica)",

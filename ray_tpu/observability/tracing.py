@@ -60,6 +60,8 @@ PROXY = "proxy"
 ROUTER = "router"
 REPLICA = "replica"
 ENGINE = "engine"
+PREFILL = "prefill"
+TRANSFER = "transfer"
 
 # Wall-clock anchor: recorded once per process so every later stamp is
 # anchor + monotonic delta. An NTP step after import cannot reorder this

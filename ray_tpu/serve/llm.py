@@ -59,7 +59,7 @@ class LLMConfig:
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "event", "result",
                  "error", "token_q", "cancelled", "trace_id", "t_enqueue",
-                 "t0_us")
+                 "t0_us", "kv_import")
 
     def __init__(self, prompt, max_new, temperature, stream=False):
         self.prompt = prompt
@@ -68,6 +68,11 @@ class _Request:
         self.event = threading.Event()
         self.result: Optional[List[int]] = None
         self.error: Optional[BaseException] = None
+        # disaggregated decode: prefill already ran elsewhere and shipped
+        # {"k", "v", "first_token", "prompt_len"} over an RpcChannel
+        # (serve/kv_transfer.py) — admission imports the KV rows instead
+        # of prefilling
+        self.kv_import: Optional[Dict[str, Any]] = None
         # observability (set at enqueue only when the switches are on):
         # trace id propagated from the proxy, wall/monotonic enqueue
         # stamps for the engine span and the TTFT histogram
@@ -90,7 +95,8 @@ class _Request:
 class _Slot:
     """One occupied KV-cache row: the request it serves + its cursor."""
 
-    __slots__ = ("req", "length", "produced", "last_token", "t_last")
+    __slots__ = ("req", "length", "produced", "last_token", "t_last",
+                 "pool", "pool_refs", "cached", "ttft_us")
 
     def __init__(self, req: _Request, length: int, first_token: int):
         self.req = req
@@ -98,6 +104,14 @@ class _Slot:
         self.produced = [first_token]
         self.last_token = first_token
         self.t_last: Optional[float] = None  # last token delivery stamp
+        # prefix-cache bookkeeping: block refs this slot holds in the
+        # engine's BlockPool (released when the request leaves the slot),
+        # and whether admission skipped any prefill work (cache hit or
+        # disaggregated KV import) — tags the engine span's TTFT split
+        self.pool = None
+        self.pool_refs: List[str] = []
+        self.cached = False
+        self.ttft_us = 0
 
 
 class LLMServer:
@@ -131,8 +145,17 @@ class LLMServer:
         self._node_tag = f"pid{os.getpid()}"
         self._stop = threading.Event()
         if config.engine == "kv":
+            from ray_tpu.serve import prefix_cache
+
+            # block pool always exists for a kv engine; the
+            # RT_SERVE_PREFIX_CACHE kill switch is checked per admission
+            # so it doubles as a runtime A/B lever
+            self._prefix_pool: Optional[prefix_cache.BlockPool] = (
+                prefix_cache.BlockPool(config.model_id)
+            )
             target = self._engine_loop_kv
         else:
+            self._prefix_pool = None
             target = self._engine_loop_recompute
         threading.Thread(
             target=target, name="llm-engine", daemon=True
@@ -163,6 +186,7 @@ class LLMServer:
         temperature = float(request.get("temperature", 0.0))
         req = _Request(prompt, max_new, temperature, stream=stream)
         req.trace_id = trace_id
+        req.kv_import = request.get("kv_import")
         return req
 
     def __call__(self, request: Any):
@@ -224,6 +248,9 @@ class LLMServer:
             "max_batch": mx,
             "mean_batch": sum(sizes) / len(sizes) if sizes else 0,
             "occupied": self._occupied,
+            "prefix": (
+                self._prefix_pool.stats() if self._prefix_pool else None
+            ),
         }
 
     def unload(self) -> None:
@@ -241,6 +268,11 @@ class LLMServer:
             if req is None:
                 break
             self._fail_request(req, err)
+        # the prefix-block pool dies with the engine: close() drops every
+        # resident block regardless of refcounts (in-flight slots fail in
+        # the loop's exit path; their refs would otherwise strand blocks)
+        if self._prefix_pool is not None:
+            self._prefix_pool.close()
 
     @staticmethod
     def _fail_request(req: "_Request", err: BaseException) -> None:
@@ -272,6 +304,8 @@ class LLMServer:
         import numpy as np
 
         from ray_tpu.models import gpt2_decode as dec
+        from ray_tpu.serve import prefix_cache
+        from ray_tpu.utils.config import config
 
         mcfg = self.model_cfg
         S = self.cfg.max_batch_size
@@ -289,24 +323,97 @@ class LLMServer:
         rng_base = self._rng
         step_no = 0
 
+        def _bucket(n: int, cap: int) -> int:
+            # next power of two: one compile per bucket, and a short
+            # prompt doesn't pay a full T_max-wide prefill
+            p = 16
+            while p < n:
+                p *= 2
+            return min(p, cap)
+
         def admit(i: int, req: _Request) -> None:
             nonlocal cache_k, cache_v
             prompt = req.prompt[-(T_max - 1):]
-            # bucket the prefill length to the next power of two: one
-            # compile per bucket, and a short prompt doesn't pay a full
-            # T_max-wide prefill
-            P = 16
-            while P < len(prompt):
-                P *= 2
-            P = min(P, T_max)
-            tok = np.zeros((1, P), np.int32)
-            tok[0, : len(prompt)] = prompt
+            pool = self._prefix_pool if config.serve_prefix_cache else None
+            held: List[str] = []
+            digests: List[str] = []
+            cached = 0
             try:
-                logits, cache_k, cache_v = dec.prefill(
-                    mcfg, self.params, jnp.asarray(tok),
-                    jnp.int32(len(prompt)), cache_k, cache_v, jnp.int32(i),
-                )
+                if req.kv_import is not None:
+                    # disaggregated decode: the prefill deployment already
+                    # computed this prompt's KV rows and first token —
+                    # import them and skip prefill entirely
+                    imp = req.kv_import
+                    n = min(int(imp["prompt_len"]), T_max - 1)
+                    C = _bucket(n, T_max)
+                    L, H, Dh = mcfg.n_layer, mcfg.n_head, mcfg.head_dim
+                    pk = np.zeros((L, C, H, Dh), np.float32)
+                    pv = np.zeros((L, C, H, Dh), np.float32)
+                    pk[:, :n] = np.asarray(imp["k"])[:, :n]
+                    pv[:, :n] = np.asarray(imp["v"])[:, :n]
+                    cache_k, cache_v = dec.write_prefix(
+                        jnp.asarray(pk), jnp.asarray(pv),
+                        cache_k, cache_v, jnp.int32(i),
+                    )
+                    first = int(imp["first_token"])
+                    prompt_len = n
+                    cached = n
+                else:
+                    if pool is not None:
+                        digests = prefix_cache.hash_blocks(
+                            prompt, pool.block_tokens
+                        )
+                        # keep >=1 prompt token uncached: the tail
+                        # prefill produces the first-token logits
+                        held, ks, vs = pool.match(
+                            digests, max_tokens=len(prompt) - 1
+                        )
+                        cached = len(held) * pool.block_tokens
+                    if cached:
+                        cache_k, cache_v = dec.write_prefix(
+                            jnp.asarray(np.concatenate(ks, axis=1)),
+                            jnp.asarray(np.concatenate(vs, axis=1)),
+                            cache_k, cache_v, jnp.int32(i),
+                        )
+                        tail = prompt[cached:]
+                        tok = np.zeros(
+                            (1, _bucket(len(tail), T_max - cached)), np.int32
+                        )
+                        tok[0, : len(tail)] = tail
+                        logits, cache_k, cache_v = dec.prefill_extend(
+                            mcfg, self.params, jnp.asarray(tok),
+                            jnp.int32(cached), jnp.int32(len(tail)),
+                            cache_k, cache_v, jnp.int32(i),
+                        )
+                    else:
+                        tok = np.zeros(
+                            (1, _bucket(len(prompt), T_max)), np.int32
+                        )
+                        tok[0, : len(prompt)] = prompt
+                        logits, cache_k, cache_v = dec.prefill(
+                            mcfg, self.params, jnp.asarray(tok),
+                            jnp.int32(len(prompt)), cache_k, cache_v,
+                            jnp.int32(i),
+                        )
+                    first = int(self._sample_one(logits, req.temperature))
+                    prompt_len = len(prompt)
+                    if pool is not None and len(digests) > len(held):
+                        # park the blocks this request just prefilled for
+                        # the next shared-prefix request (host copies of
+                        # the slot's fresh K/V rows)
+                        row_k = np.asarray(cache_k[:, i])
+                        row_v = np.asarray(cache_v[:, i])
+                        B = pool.block_tokens
+                        for j in range(len(held), len(digests)):
+                            pool.insert(
+                                digests[j],
+                                row_k[:, j * B:(j + 1) * B].copy(),
+                                row_v[:, j * B:(j + 1) * B].copy(),
+                            )
+                        held = list(digests)
             except Exception as e:  # noqa: BLE001
+                if pool is not None and held:
+                    pool.release(held)
                 req.error = e
                 req.event.set()
                 if req.token_q is not None:
@@ -317,9 +424,13 @@ class LLMServer:
                 # and marks the caches for rebuild (this request's error
                 # is already set; fail_inflight won't see it in slots)
                 raise
-            first = int(self._sample_one(logits, req.temperature))
-            slot = _Slot(req, len(prompt), first)
+            slot = _Slot(req, prompt_len, first)
+            slot.pool = pool
+            slot.pool_refs = held
+            slot.cached = cached > 0
             slots[i] = slot
+            if tracing.ENABLED and req.t0_us:
+                slot.ttft_us = tracing.now_us() - req.t0_us
             if core_metrics.ENABLED:
                 now = time.monotonic()
                 slot.t_last = now
@@ -334,19 +445,28 @@ class LLMServer:
                 # unrequested first token into the stream
                 req.token_q.put(first)
             last[i] = first
-            lengths[i] = len(prompt)
+            lengths[i] = prompt_len
             temps[i] = max(req.temperature, 1e-6)
             greedy[i] = req.temperature <= 0
+
+        def release_refs(s: _Slot) -> None:
+            # the request is leaving its slot: drop its prefix-block refs
+            # (blocks stay resident, just become LRU-evictable)
+            if s.pool is not None and s.pool_refs:
+                s.pool.release(s.pool_refs)
+                s.pool_refs = []
 
         def finish(i: int) -> None:
             slot = slots[i]
             slots[i] = None
+            release_refs(slot)
             slot.req.result = slot.produced[: slot.req.max_new]
             if tracing.ENABLED and slot.req.trace_id and slot.req.t0_us:
                 tracing.emit(tracing.request_span(
                     slot.req.trace_id, tracing.ENGINE, self.cfg.model_id,
                     slot.req.t0_us, tracing.now_us() - slot.req.t0_us,
                     tokens=len(slot.req.result),
+                    cached=slot.cached, ttft_us=slot.ttft_us,
                 ))
             slot.req.event.set()
             if slot.req.token_q is not None:
@@ -358,6 +478,7 @@ class LLMServer:
             # occupied slot's request and keep serving.
             for i in range(S):
                 if slots[i] is not None:
+                    release_refs(slots[i])
                     slots[i].req.error = e
                     slots[i].req.event.set()
                     if slots[i].req.token_q is not None:
@@ -377,6 +498,7 @@ class LLMServer:
                 s = slots[i]
                 if s is not None and s.req.cancelled:
                     slots[i] = None
+                    release_refs(s)
                     s.req.event.set()
                     dev_state = None
             # admit new requests into free slots (continuous batching)
@@ -649,6 +771,8 @@ def deploy(
     ray_actor_options: Optional[Dict[str, float]] = None,
     wait_ready: bool = True,
     ready_timeout_s: float = 300.0,
+    disaggregated: bool = False,
+    prefill_replicas: int = 1,
 ):
     """Run the OpenAI-compatible front door (parity: the reference's
     ``serve.llm build_openai_app`` + ``serve.run``): a multi-replica
@@ -663,9 +787,31 @@ def deploy(
     replicas already holding the requested model; the OpenAI ``user``
     field pins a session to one replica's warm KV slots.
 
+    ``disaggregated=True`` additionally runs a ``<name>-prefill``
+    deployment (serve/kv_transfer.py): ingress replicas send every
+    prompt there for prefill and import the KV rows over an RpcChannel,
+    keeping only decode local (kill switch RT_SERVE_DISAGG=0 reverts to
+    local prefill without redeploying).
+
     Returns the DeploymentHandle."""
     from ray_tpu.serve.openai.ingress import build_openai_deployment
 
+    prefill_name = None
+    if disaggregated:
+        from ray_tpu.serve.kv_transfer import PrefillServer
+
+        prefill_name = f"{name}-prefill"
+        prefill_dep = serve.deployment(
+            PrefillServer,
+            name=prefill_name,
+            num_replicas=prefill_replicas,
+            route_prefix=None,  # internal tier: no HTTP surface
+            max_concurrency=max_concurrency,
+        ).bind(models, max_engines_per_replica=max_engines_per_replica)
+        serve.run(
+            prefill_dep, wait_ready=wait_ready,
+            ready_timeout_s=ready_timeout_s,
+        )
     app = build_openai_deployment(
         models,
         name=name,
@@ -676,6 +822,7 @@ def deploy(
         max_concurrency=max_concurrency,
         autoscaling_config=autoscaling_config,
         ray_actor_options=ray_actor_options,
+        prefill_deployment=prefill_name,
     )
     return serve.run(
         app, wait_ready=wait_ready, ready_timeout_s=ready_timeout_s

@@ -17,6 +17,7 @@ session key) without interpreting the rest.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import time
 import uuid
@@ -342,20 +343,61 @@ class Probe:
     """Routing hints the HTTP proxy extracts from an OpenAI request
     without fully interpreting it: whether the response streams (the
     stream flag lives in the JSON body, not the query string), which
-    model it targets (multiplex warm-engine affinity) and the session
-    key (same `user` sticks to the replica holding its warm KV slots)."""
+    model it targets (multiplex warm-engine affinity), the session
+    key (same `user` sticks to the replica holding its warm KV slots)
+    and the prefix hint (requests sharing leading prompt text land on
+    the replica whose engine holds those prefix KV blocks)."""
 
-    __slots__ = ("endpoint", "stream", "model", "session_key")
+    __slots__ = ("endpoint", "stream", "model", "session_key",
+                 "prefix_hint")
 
     def __init__(self, endpoint: str, stream: bool,
-                 model: Optional[str], session_key: Optional[str]):
+                 model: Optional[str], session_key: Optional[str],
+                 prefix_hint: Optional[str] = None):
         self.endpoint = endpoint
         self.stream = stream
         self.model = model
         self.session_key = session_key
+        self.prefix_hint = prefix_hint
 
 
 _SESSION_HEADER = "x-session-id"
+
+# Prefix-hint contract (must match across proxies; the engine's block
+# pool is what the hint targets, so the geometry tracks the default
+# serve_prefix_block_tokens=64 under the 1-byte-per-token tokenizer):
+# hash the first <=256 chars of the rendered prompt, but only when at
+# least 64 chars exist — shorter prompts share no full 64-token block,
+# and pinning them all to one rendezvous replica would just hotspot it.
+_PREFIX_HINT_MAX_CHARS = 256
+_PREFIX_HINT_MIN_CHARS = 64
+
+
+def _prefix_hint(obj: Dict[str, Any]) -> Optional[str]:
+    """Content digest of the request's leading prompt text. Pure
+    function of the body (no pid/salt) so every proxy maps a shared
+    system prompt to the same rendezvous key. Chat bodies reuse the
+    tokenizer's chat template rendering for the leading messages so the
+    hinted text is exactly what the engine will tokenize."""
+    if isinstance(obj.get("prompt"), str):
+        lead = obj["prompt"]
+    elif isinstance(obj.get("messages"), list):
+        parts = []
+        for m in obj["messages"]:
+            if not isinstance(m, dict):
+                return None
+            parts.append(f"<|{m.get('role')}|>{m.get('content')}")
+            if sum(len(p) for p in parts) >= _PREFIX_HINT_MAX_CHARS:
+                break
+        lead = "\n".join(parts)
+    else:
+        return None
+    if len(lead) < _PREFIX_HINT_MIN_CHARS:
+        return None
+    return hashlib.blake2b(
+        lead[:_PREFIX_HINT_MAX_CHARS].encode("utf-8", "replace"),
+        digest_size=8,
+    ).hexdigest()
 
 
 def probe(method: str, path: str, body: bytes,
@@ -387,6 +429,7 @@ def probe(method: str, path: str, body: bytes,
         endpoint, bool(obj.get("stream")),
         str(model) if model is not None else None,
         str(user) if user is not None else None,
+        _prefix_hint(obj),
     )
 
 

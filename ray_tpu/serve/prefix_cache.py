@@ -1,0 +1,232 @@
+"""Block-granular prefix KV cache for the LLM engine.
+
+vLLM-style automatic prefix caching ported to the slot-cache engine
+(serve/llm.py): prompts are chopped into fixed-size token blocks, each
+block is identified by a CHAIN hash (its own tokens + the parent
+block's digest, so a digest names an entire prefix, not just 64 loose
+tokens), and the host-side K/V for every full block a request prefills
+is parked in a per-engine refcounted pool. The next request sharing
+that prefix copies the matched blocks straight into its slot
+(gpt2_decode.write_prefix) and prefills only the uncached tail
+(gpt2_decode.prefill_extend) — TTFT stops paying for the shared system
+prompt.
+
+Lifecycle contract: ``match`` and ``insert`` both leave the caller
+holding ONE ref per returned/inserted digest; the engine releases them
+when the request leaves its slot (finish/cancel/fail/unload). Only
+refcount-0 blocks are LRU-evictable; ``close()`` drops everything
+regardless of refcounts — a multiplex eviction must not strand
+resident blocks (the pool is gone with the engine).
+
+Kill switch: RT_SERVE_PREFIX_CACHE=0 (checked at admission, so it
+doubles as bench_core's A/B lever at runtime).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.observability import core_metrics
+from ray_tpu.utils.config import config
+
+# Live pools in this process (engine model_id -> pool), for unload
+# accounting and tests. An engine owns at most one pool.
+_POOLS: Dict[int, "BlockPool"] = {}
+_POOLS_LOCK = threading.Lock()
+
+
+def hash_blocks(tokens: Sequence[int], block_tokens: int) -> List[str]:
+    """Chained content digests of the prompt's FULL blocks.
+
+    digest_i = blake2b(digest_{i-1} || int32 tokens of block i), so two
+    prompts share digest_i iff they share the entire first (i+1) blocks
+    — a pool lookup never has to compare token lists, and the digests
+    are stable across processes/replicas (pure content, no pid/seed).
+    The trailing partial block is never hashed: only full blocks are
+    cacheable."""
+    n_full = len(tokens) // block_tokens
+    if n_full <= 0:
+        return []
+    arr = np.asarray(tokens[: n_full * block_tokens], dtype=np.int32)
+    out: List[str] = []
+    parent = b""
+    for i in range(n_full):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent)
+        h.update(arr[i * block_tokens : (i + 1) * block_tokens].tobytes())
+        parent = h.digest()
+        out.append(parent.hex())
+    return out
+
+
+class _Block:
+    __slots__ = ("digest", "k", "v", "refs", "tick")
+
+    def __init__(self, digest: str, k: np.ndarray, v: np.ndarray):
+        self.digest = digest
+        self.k = k  # [L, B, H, Dh] host copy, engine compute dtype
+        self.v = v
+        self.refs = 0
+        self.tick = 0
+
+
+class BlockPool:
+    """Refcounted, LRU-evicted pool of prefix KV blocks for one engine."""
+
+    def __init__(self, model_id: str, block_tokens: Optional[int] = None,
+                 max_blocks: Optional[int] = None):
+        self.model_id = model_id
+        self.block_tokens = int(
+            block_tokens or config.serve_prefix_block_tokens
+        )
+        self.max_blocks = int(max_blocks or config.serve_prefix_pool_blocks)
+        self._lock = threading.Lock()
+        self._blocks: Dict[str, _Block] = {}
+        self._tick = 0
+        self._closed = False
+        # plain counters independent of the metrics kill switch, for
+        # engine stats()/bench assertions
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._node_tag = f"pid{os.getpid()}"
+        with _POOLS_LOCK:
+            _POOLS[id(self)] = self
+
+    # -- lookup / insert / release ------------------------------------
+
+    def match(
+        self, digests: Sequence[str], max_tokens: int
+    ) -> Tuple[List[str], List[np.ndarray], List[np.ndarray]]:
+        """Longest resident chain prefix of ``digests``, capped so at
+        most ``max_tokens`` tokens come from cache (the engine keeps at
+        least one prompt token for the tail prefill — a fully-cached
+        prompt would have nothing to produce first-token logits from).
+        Increfs every matched block; caller must release()."""
+        cap = max(0, int(max_tokens)) // self.block_tokens
+        held: List[str] = []
+        ks: List[np.ndarray] = []
+        vs: List[np.ndarray] = []
+        with self._lock:
+            if not self._closed:
+                for d in digests[:cap]:
+                    blk = self._blocks.get(d)
+                    if blk is None:
+                        break
+                    blk.refs += 1
+                    self._tick += 1
+                    blk.tick = self._tick
+                    held.append(d)
+                    ks.append(blk.k)
+                    vs.append(blk.v)
+            hits = len(held)
+            misses = len(digests) - hits
+            self.hits += hits
+            self.misses += misses
+            if core_metrics.ENABLED:
+                tags = {"deployment": self.model_id}
+                if hits:
+                    core_metrics.serve_prefix_cache_hits.inc(hits, tags=tags)
+                if misses:
+                    core_metrics.serve_prefix_cache_misses.inc(
+                        misses, tags=tags
+                    )
+        return held, ks, vs
+
+    def insert(self, digest: str, k: np.ndarray, v: np.ndarray) -> None:
+        """Park one block's host K/V ``[L, B, H, Dh]``; a block already
+        resident is just touched (re-insert after a capped match). The
+        caller holds one ref either way until release()."""
+        with self._lock:
+            if self._closed:
+                return
+            blk = self._blocks.get(digest)
+            if blk is None:
+                blk = _Block(digest, k, v)
+                self._blocks[digest] = blk
+            blk.refs += 1
+            self._tick += 1
+            blk.tick = self._tick
+            self._evict_locked()
+            self._publish_resident_locked()
+
+    def release(self, digests: Sequence[str]) -> None:
+        """Drop the caller's refs (request left its slot); newly
+        refcount-0 blocks become LRU-evictable but stay resident —
+        that residency IS the cache."""
+        if not digests:
+            return
+        with self._lock:
+            for d in digests:
+                blk = self._blocks.get(d)
+                if blk is not None and blk.refs > 0:
+                    blk.refs -= 1
+            self._evict_locked()
+            self._publish_resident_locked()
+
+    # -- maintenance ---------------------------------------------------
+
+    def _evict_locked(self) -> None:
+        while len(self._blocks) > self.max_blocks:
+            victim = None
+            for blk in self._blocks.values():
+                if blk.refs == 0 and (
+                    victim is None or blk.tick < victim.tick
+                ):
+                    victim = blk
+            if victim is None:
+                return  # everything pinned by in-flight requests
+            del self._blocks[victim.digest]
+            self.evictions += 1
+            if core_metrics.ENABLED:
+                core_metrics.serve_prefix_cache_evictions.inc(
+                    tags={"deployment": self.model_id}
+                )
+
+    def _publish_resident_locked(self) -> None:
+        if core_metrics.ENABLED:
+            core_metrics.serve_prefix_blocks_resident.set(
+                len(self._blocks),
+                tags={"deployment": self.model_id, "node": self._node_tag},
+            )
+
+    def resident(self) -> int:
+        with self._lock:
+            return len(self._blocks)
+
+    def ref_count(self, digest: str) -> int:
+        with self._lock:
+            blk = self._blocks.get(digest)
+            return blk.refs if blk is not None else 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "blocks": len(self._blocks),
+                "block_tokens": self.block_tokens,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+            }
+
+    def close(self) -> None:
+        """Unconditionally drop every block (engine unload/eviction):
+        outstanding refs die with the engine's slots, so honoring them
+        would strand the blocks forever."""
+        with self._lock:
+            self._blocks.clear()
+            self._closed = True
+            self._publish_resident_locked()
+        with _POOLS_LOCK:
+            _POOLS.pop(id(self), None)
+
+
+def live_pools() -> List[BlockPool]:
+    """Pools not yet close()d in this process (test/debug hook)."""
+    with _POOLS_LOCK:
+        return list(_POOLS.values())

@@ -84,11 +84,15 @@ class ServeProxy:
             return None
         if probe is not None:
             model_id, session_key = probe.model, probe.session_key
+            prefix_hint = (
+                probe.prefix_hint if config.serve_prefix_cache else None
+            )
         else:
             model_id = (
                 headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
             )
             session_key = None
+            prefix_hint = None
         from ray_tpu.observability import tracing
 
         trace = None
@@ -97,7 +101,9 @@ class ServeProxy:
                         or tracing.new_trace_id())
             headers[tracing.TRACE_HEADER] = trace_id
             trace = (trace_id, None, tracing.now_us())
-        picked = self._router.try_pick_nowait(path, model_id, session_key)
+        picked = self._router.try_pick_nowait(
+            path, model_id, session_key, prefix_hint
+        )
         if picked is None:
             return None
         deployment, rid, handle = picked
@@ -284,6 +290,8 @@ class ServeProxy:
                 f"no route for {path}", err_type="invalid_request_error",
                 code="route_not_found",
             )
+        from ray_tpu.utils.config import config
+
         trace = self._trace_begin(headers, deployment)
         request = Request(method, path, body, headers, query)
         if probe.stream:
@@ -292,6 +300,9 @@ class ServeProxy:
             result = self._router.call_direct(
                 deployment, request, timeout_s=300,
                 model_id=probe.model, session_key=probe.session_key,
+                prefix_hint=(
+                    probe.prefix_hint if config.serve_prefix_cache else None
+                ),
             )
         except (TimeoutError, RpcTimeout) as e:
             self._trace_end(trace, 503)
@@ -316,11 +327,16 @@ class ServeProxy:
         covers the whole stream (the e2e number request_summary rolls
         up)."""
 
+        from ray_tpu.utils.config import config
+
+        hint = probe.prefix_hint if config.serve_prefix_cache else None
+
         def gen():
             try:
                 for item in self._router.call_streaming(
                     deployment, request, timeout_s=600,
                     model_id=probe.model, session_key=probe.session_key,
+                    prefix_hint=hint,
                 ):
                     yield item if isinstance(item, bytes) else oai.sse_event(
                         item

@@ -136,7 +136,8 @@ class Router:
 
     def choose_replica(self, deployment: str, timeout_s: float = 30.0,
                        model_id: Optional[str] = None,
-                       session_key: Optional[str] = None):
+                       session_key: Optional[str] = None,
+                       prefix_hint: Optional[str] = None):
         """Pow-2 choice; blocks (re-polling) until a replica exists.
         With a multiplexed ``model_id``, replicas already holding that
         model are preferred (reference multiplex routing hint) — traffic
@@ -146,7 +147,12 @@ class Router:
         rendezvous hashing over the FULL replica set (KV/session
         affinity): sessions spread across every replica — each loading
         the model on its first session — rather than piling onto
-        whichever replica warmed the model first."""
+        whichever replica warmed the model first. ``prefix_hint`` (a
+        digest of the request's leading prompt text, computed by the
+        proxy) rendezvous-hashes the same way when no session pins the
+        request: requests sharing a system prompt land on the replica
+        whose engine already holds those prefix KV blocks."""
+        affinity = session_key or prefix_hint
         t0 = time.monotonic()
         deadline = t0 + timeout_s
         while True:
@@ -154,7 +160,7 @@ class Router:
             with self._lock:
                 dep = self._table.get(deployment)
                 replicas = list(dep["replicas"]) if dep else []
-                if replicas and model_id and not session_key:
+                if replicas and model_id and not affinity:
                     holding = [
                         r for r in replicas
                         if model_id in r.get("model_ids", [])
@@ -162,8 +168,8 @@ class Router:
                     if holding:
                         replicas = holding
                 if replicas:
-                    if session_key:
-                        chosen = self._rendezvous(session_key, replicas)
+                    if affinity:
+                        chosen = self._rendezvous(affinity, replicas)
                     elif len(replicas) == 1:
                         chosen = replicas[0]
                     else:
@@ -194,7 +200,8 @@ class Router:
 
     def try_pick_nowait(self, path: str,
                         model_id: Optional[str] = None,
-                        session_key: Optional[str] = None):
+                        session_key: Optional[str] = None,
+                        prefix_hint: Optional[str] = None):
         """Event-loop-safe replica pick: route-match + selection against
         the CURRENT table only — no refresh RPC, no polling, no sleeps.
         Returns (deployment, replica_id, handle) or None when the table
@@ -218,15 +225,16 @@ class Router:
             replicas = list(self._table[best]["replicas"])
             if not replicas:
                 return None
-            if model_id and not session_key:
+            affinity = session_key or prefix_hint
+            if model_id and not affinity:
                 holding = [
                     r for r in replicas
                     if model_id in r.get("model_ids", [])
                 ]
                 if holding:
                     replicas = holding
-            if session_key:
-                chosen = self._rendezvous(session_key, replicas)
+            if affinity:
+                chosen = self._rendezvous(affinity, replicas)
             elif len(replicas) == 1:
                 chosen = replicas[0]
             else:
@@ -255,10 +263,11 @@ class Router:
     def assign(self, deployment: str, payload: Any,
                method: Optional[str] = None, timeout_s: float = 30.0,
                model_id: Optional[str] = None,
-               session_key: Optional[str] = None):
+               session_key: Optional[str] = None,
+               prefix_hint: Optional[str] = None):
         """Route one request; returns (replica_id, result ObjectRef)."""
         rid, handle = self.choose_replica(
-            deployment, timeout_s, model_id, session_key
+            deployment, timeout_s, model_id, session_key, prefix_hint
         )
         if method:
             return rid, handle.handle_request.remote(payload, method=method)
@@ -268,7 +277,8 @@ class Router:
                        method: Optional[str] = None,
                        timeout_s: float = 60.0,
                        model_id: Optional[str] = None,
-                       session_key: Optional[str] = None):
+                       session_key: Optional[str] = None,
+                       prefix_hint: Optional[str] = None):
         """Route one request to the replica's streaming entry point and
         yield items as they are produced (core actor streaming
         generators). The in-flight delta is held until the stream is
@@ -279,7 +289,7 @@ class Router:
         tid = _trace_id_of(payload) if tracing.ENABLED else None
         t0u = tracing.now_us() if tid else 0
         rid, handle = self.choose_replica(
-            deployment, timeout_s, model_id, session_key
+            deployment, timeout_s, model_id, session_key, prefix_hint
         )
         if tid and tracing.ENABLED:
             tracing.emit(tracing.request_span(
@@ -318,7 +328,8 @@ class Router:
     def call(self, deployment: str, payload: Any,
              method: Optional[str] = None, timeout_s: float = 60.0,
              model_id: Optional[str] = None,
-             session_key: Optional[str] = None) -> Any:
+             session_key: Optional[str] = None,
+             prefix_hint: Optional[str] = None) -> Any:
         """Route + get with retry on replica death: the routing table lags
         replica failures by up to a health-check period, so a request that
         lands on a corpse is transparently re-routed (reference: the
@@ -336,7 +347,7 @@ class Router:
             t0u = tracing.now_us() if tid else 0
             rid, ref = self.assign(
                 deployment, payload, method, remaining, model_id,
-                session_key,
+                session_key, prefix_hint,
             )
             if tid and tracing.ENABLED:
                 tracing.emit(tracing.request_span(
@@ -359,7 +370,8 @@ class Router:
     def call_direct(self, deployment: str, payload: Any,
                     method: Optional[str] = None, timeout_s: float = 60.0,
                     model_id: Optional[str] = None,
-                    session_key: Optional[str] = None) -> Any:
+                    session_key: Optional[str] = None,
+                    prefix_hint: Optional[str] = None) -> Any:
         """One-hop request: proxy → the replica's hosting worker over a
         single RPC (rpc_actor_direct_call) instead of the actor-task
         machinery (TaskSpec + submit/reply threads + owner memory store).
@@ -379,7 +391,7 @@ class Router:
         if not config.serve_direct_rpc:
             return self.call(
                 deployment, payload, method, timeout_s, model_id,
-                session_key,
+                session_key, prefix_hint,
             )
         w = worker_mod.global_worker()
         deadline = time.monotonic() + timeout_s
@@ -389,7 +401,7 @@ class Router:
             remaining = max(0.5, deadline - time.monotonic())
             t0u = tracing.now_us() if tid else 0
             rid, handle = self.choose_replica(
-                deployment, remaining, model_id, session_key
+                deployment, remaining, model_id, session_key, prefix_hint
             )
             if tid and tracing.ENABLED:
                 tracing.emit(tracing.request_span(
@@ -433,7 +445,7 @@ class Router:
                 return self.call(
                     deployment, payload, method,
                     max(0.5, deadline - time.monotonic()), model_id,
-                    session_key,
+                    session_key, prefix_hint,
                 )
             return self._unwrap_direct(reply[1])
         raise last_exc

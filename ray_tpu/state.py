@@ -226,7 +226,8 @@ def timeline(address: Optional[str] = None,
             # serve request leg: one slice per component, joined below
             # into a cross-pid flow by trace id
             args = {"trace_id": e["trace_id"]}
-            for k in ("queue_us", "status", "model"):
+            for k in ("queue_us", "status", "model", "cached", "ttft_us",
+                      "tokens", "kv_bytes"):
                 if k in e:
                     args[k] = e[k]
             trace.append({
@@ -414,7 +415,11 @@ def request_summary(address: Optional[str] = None) -> Dict[str, Any]:
     spans stamped along the proxy → router → replica → engine path:
     end-to-end (proxy span), queue (router span: pick + wait for a
     replica assignment), and execution (replica span), each as
-    p50/p95/p99/mean/max seconds."""
+    p50/p95/p99/mean/max seconds. Engine spans additionally split
+    time-to-first-token by prefix-cache outcome (ttft_cached_s vs
+    ttft_cold_s), and disaggregated deployments contribute prefill_s /
+    transfer_s legs, so a hot-vs-cold or remote-prefill regression is
+    visible without raw span spelunking."""
     events, dropped = _collect_task_events(address)
     per_dep: Dict[str, Dict[str, List[float]]] = {}
     for e in events:
@@ -431,6 +436,15 @@ def request_summary(address: Optional[str] = None) -> Dict[str, Any]:
             rec["queue_s"].append(dur_s)
         elif comp == "replica":
             rec["exec_s"].append(dur_s)
+        elif comp == "engine":
+            ttft_us = e.get("ttft_us")
+            if ttft_us:
+                key = "ttft_cached_s" if e.get("cached") else "ttft_cold_s"
+                rec.setdefault(key, []).append(ttft_us / 1e6)
+        elif comp == "prefill":
+            rec.setdefault("prefill_s", []).append(dur_s)
+        elif comp == "transfer":
+            rec.setdefault("transfer_s", []).append(dur_s)
     deployments = {}
     for dep, rec in sorted(per_dep.items()):
         deployments[dep] = _latency_entry(rec, "e2e_s")

@@ -223,3 +223,26 @@ config.define("temp_dir", "/tmp/ray_tpu")
 # hot path costs a single attribute check, not a registry lookup.
 config.define("trace_events", True)
 config.define("observability_enabled", True)
+# Prefix KV caching (serve/prefix_cache.py): content-hashed prompt
+# prefix blocks are kept in a refcounted, LRU-evicted per-engine pool
+# and copied into a slot at admission instead of re-running prefill
+# over them. RT_SERVE_PREFIX_CACHE=0 is the kill switch (and the A/B
+# lever for bench_core's TTFT rows): every admission pays full prefill.
+config.define("serve_prefix_cache", True)
+# Tokens per prefix block: the unit of hashing, refcounting and reuse.
+# Must be uniform across replicas of a deployment (the router's
+# prefix-hash hint assumes one block geometry).
+config.define("serve_prefix_block_tokens", 64)
+# Max resident blocks per engine pool; refcount-0 blocks evict LRU
+# beyond this.
+config.define("serve_prefix_pool_blocks", 512)
+# Disaggregated prefill/decode (serve/kv_transfer.py): the ingress
+# calls a separate prefill deployment which ships the slot's KV rows
+# back over an RpcChannel (zero-copy multiseg frames); the local engine
+# imports them and only decodes. RT_SERVE_DISAGG=0 is the kill switch —
+# every request prefills in the decode replica even when a prefill
+# deployment exists.
+config.define("serve_disagg", True)
+# Budget for one prefill+transfer leg; a SIGKILLed prefill replica
+# surfaces as a request failure within this, never a decode hang.
+config.define("serve_disagg_timeout_s", 60.0)

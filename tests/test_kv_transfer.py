@@ -1,0 +1,210 @@
+"""Disaggregated prefill/decode serving (serve/kv_transfer.py): the
+prefill tier runs as its own deployment and ships KV rows to the decode
+ingress over an RpcChannel. End-to-end: a disaggregated deployment must
+serve /v1/chat/completions (unary + SSE, byte-identical to each other
+and to a monolithic engine at temperature=0), join prefill → transfer →
+engine into one trace, and FAIL requests within the disagg deadline when
+the prefill replica is SIGKILLed — never hang decode on a half-open
+channel."""
+
+import http.client
+import json
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve, state
+from ray_tpu.observability import tracing
+
+MODEL = "tiny"
+DEPLOYMENT = "disagg-llm"
+PREFILL = f"{DEPLOYMENT}-prefill"
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=8)
+    serve.start(http_port=0)
+    yield ray_tpu
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def front(rt):
+    """Disaggregated deployment: 2 decode/ingress replicas + 1 prefill
+    replica, plus the proxy address serving it."""
+    from ray_tpu.serve import llm as serve_llm
+
+    serve_llm.deploy(
+        {MODEL: serve_llm.LLMConfig(model_id="gpt2-tiny", max_batch_size=4)},
+        name=DEPLOYMENT, num_replicas=2, route_prefix="/v1",
+        disaggregated=True, prefill_replicas=1,
+    )
+    deadline = time.monotonic() + 60
+    addrs = []
+    while time.monotonic() < deadline and not addrs:
+        addrs = serve.proxy_addresses()
+        time.sleep(0.2)
+    assert addrs, "no HTTP proxy came up"
+    yield addrs[0]
+    serve.delete(DEPLOYMENT)
+    serve.delete(PREFILL)
+
+
+def _post(addr, path, body, timeout=180):
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=json.dumps(body).encode(),
+        method="POST", headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _stream_chat(addr, body, headers=None, timeout=180):
+    host, port = addr.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/v1/chat/completions", body=json.dumps(body),
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        resp = conn.getresponse()
+        raw = resp.read().decode()
+        events = [b[len("data: "):] for b in raw.split("\n\n") if b.strip()]
+        return resp.status, events
+    finally:
+        conn.close()
+
+
+def _chat_body(content, **extra):
+    return {"model": MODEL, "max_tokens": 8, "temperature": 0,
+            "messages": [{"role": "user", "content": content}], **extra}
+
+
+def test_disagg_unary_stream_and_monolithic_parity(front):
+    """The acceptance request: the same chat completion through the
+    disaggregated stack — unary and SSE — produces identical text, and
+    that text matches a monolithic (local-prefill) engine bit for bit at
+    temperature=0: remote prefill + KV import changed WHERE prefill ran,
+    not what got generated."""
+    addr = front
+    # rendered chat prompt must leave decode room inside n_positions
+    # (128 for gpt2-tiny) while still spanning a full 64-token block
+    content = (
+        "shared system preamble long enough to span a prefix block: "
+        + "x" * 30
+    )
+    st, out = _post(addr, "/v1/chat/completions", _chat_body(content))
+    assert st == 200, out
+    text = out["choices"][0]["message"]["content"]
+    assert out["usage"]["completion_tokens"] == 8
+
+    st2, events = _stream_chat(addr, _chat_body(content, stream=True))
+    assert st2 == 200 and events[-1] == "[DONE]"
+    streamed = "".join(
+        json.loads(e)["choices"][0]["delta"].get("content", "")
+        for e in events[:-1]
+    )
+    assert streamed == text
+
+    # monolithic reference: same weights recipe, local prefill
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    from ray_tpu.serve.openai import tokenizer as tokenizer_mod
+
+    tok = tokenizer_mod.ByteTokenizer()
+    prompt = tok.encode(
+        tokenizer_mod.render_chat(_chat_body(content)["messages"])
+    )
+    mono = LLMServer(LLMConfig(model_id="gpt2-tiny", max_batch_size=4))
+    try:
+        ref = mono({"prompt_tokens": prompt, "max_new_tokens": 8,
+                    "temperature": 0.0})["tokens"]
+    finally:
+        mono._stop.set()
+    assert text == tok.decode(ref)
+
+
+def test_disagg_request_joins_one_trace(front):
+    """One traced SSE request shows the full disaggregated flow: proxy,
+    router, replica, engine AND the prefill + transfer legs all stamped
+    with the client's trace id."""
+    addr = front
+    tid = "feedfacecafe0d15"
+    st, events = _stream_chat(
+        addr, _chat_body("trace the disaggregated path", stream=True),
+        headers={tracing.TRACE_HEADER: tid},
+    )
+    assert st == 200 and events[-1] == "[DONE]"
+    deadline = time.monotonic() + 30
+    comps = set()
+    spans = []
+    want = {"proxy", "router", "replica", "engine", "prefill", "transfer"}
+    while time.monotonic() < deadline:
+        spans = [
+            ev for ev in state.timeline()
+            if ev.get("cat") == "request" and ev.get("ph") == "X"
+            and ev["args"].get("trace_id") == tid
+        ]
+        comps = {ev["name"].split(":")[0] for ev in spans}
+        if want <= comps:
+            break
+        time.sleep(0.3)
+    assert want <= comps, spans
+    # the prefill leg names the OpenAI model it prefilled for and ships
+    # a non-trivial KV payload
+    pre = next(ev for ev in spans if ev["name"] == f"prefill:{MODEL}")
+    assert pre["args"].get("kv_bytes", 0) > 0
+    # the legs roll up into request_summary: prefill/transfer under the
+    # OpenAI model's row, TTFT (imported KV counts as cached) under the
+    # engine's model-id row
+    summary = state.request_summary()["deployments"]
+    assert "prefill_s" in summary[MODEL]
+    assert "transfer_s" in summary[MODEL]
+    assert "ttft_cached_s" in summary["gpt2-tiny"]
+
+
+def test_sigkilled_prefill_replica_fails_within_deadline(front, rt):
+    """Kill -9 the prefill replica: an in-flight/next request must fail
+    within the RT_SERVE_DISAGG_TIMEOUT_S budget (ActorDied/Timeout on
+    the ack or channel read), not strand the decode side. Runs last in
+    the module — the controller respawns the replica afterwards."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.serve import kv_transfer
+    from ray_tpu.utils.config import config
+
+    h = serve.get_deployment_handle(PREFILL)
+    info = h.remote({"op": "info"}).result(timeout_s=60)
+    assert info["models"] == [MODEL]
+
+    # warm-up doubles as a unit test of the driver-side orchestration:
+    # the shipment has full-shape KV rows and the monolithic first token
+    mcfg = gpt2.CONFIGS["gpt2-tiny"]
+    prompt = [int(t) for t in np.random.RandomState(5).randint(0, 256, 70)]
+    imp = kv_transfer.prefill_remote(
+        PREFILL, MODEL, {"prompt_tokens": prompt, "temperature": 0.0}, mcfg
+    )
+    assert imp["prompt_len"] == 70
+    assert imp["k"].shape == (mcfg.n_layer, 70, mcfg.n_head, mcfg.head_dim)
+
+    os.kill(info["pid"], signal.SIGKILL)
+    config.set("serve_disagg_timeout_s", 4.0)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(Exception):
+            kv_transfer.prefill_remote(
+                PREFILL, MODEL,
+                {"prompt_tokens": prompt, "temperature": 0.0}, mcfg,
+            )
+    finally:
+        config.set("serve_disagg_timeout_s", 60.0)
+    assert time.monotonic() - t0 < 20

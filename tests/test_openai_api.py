@@ -394,6 +394,25 @@ def test_engine_unload_fails_inflight_requests(monkeypatch):
     assert time.monotonic() - t0 < 10
 
 
+def test_engine_unload_releases_prefix_block_pool():
+    """Multiplex eviction must not leak the prefix pool: after unload()
+    the pool is closed (0 resident blocks, unregistered) even when the
+    evicted engine still had cached blocks parked."""
+    from ray_tpu.serve import prefix_cache
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+
+    server = LLMServer(LLMConfig(model_id="gpt2-tiny", max_batch_size=2))
+    prompt = list(range(100))
+    server({"prompt_tokens": prompt, "max_new_tokens": 2,
+            "temperature": 0.0})
+    pool = server._prefix_pool
+    assert pool.resident() > 0
+    assert pool in prefix_cache.live_pools()
+    server.unload()
+    assert pool.resident() == 0
+    assert pool not in prefix_cache.live_pools()
+
+
 # ---------------------------------------------------------------------------
 # tokenizer + protocol units
 # ---------------------------------------------------------------------------

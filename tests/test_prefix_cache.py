@@ -1,0 +1,198 @@
+"""Prefix KV cache (serve/prefix_cache.py + the engine's prefix-aware
+admission): chain-hash determinism (including across processes — the
+router's affinity hint and multi-replica pools depend on it), the
+refcount/LRU pool contract, and the serving guarantee: admitting a
+request from cached blocks produces bitwise-identical generations at
+temperature=0, under slot churn, and with the kill switch flipped."""
+
+import json
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.prefix_cache import BlockPool, hash_blocks
+
+
+# ---------------------------------------------------------------------------
+# chain hashing
+# ---------------------------------------------------------------------------
+
+
+def test_hash_blocks_only_full_blocks():
+    assert hash_blocks([], 4) == []
+    assert hash_blocks([1, 2, 3], 4) == []
+    assert len(hash_blocks(list(range(10)), 4)) == 2
+    assert len(hash_blocks(list(range(8)), 4)) == 2
+
+
+def test_hash_blocks_chain_prefix_property():
+    a = hash_blocks([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], 4)
+    b = hash_blocks([1, 2, 3, 4, 5, 6, 7, 8, 99, 99, 99, 99], 4)
+    assert a[:2] == b[:2] and a[2] != b[2]
+    # the chain: a different FIRST block changes every downstream digest
+    c = hash_blocks([9, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], 4)
+    assert all(x != y for x, y in zip(a, c))
+
+
+def test_hash_blocks_deterministic_across_processes():
+    """Digests are pure content hashes — another interpreter produces
+    exactly the same chain (no pid/seed/hash-randomization leakage), so
+    pools on different replicas agree on block identity."""
+    tokens = [int(t) for t in np.random.RandomState(3).randint(0, 256, 200)]
+    prog = (
+        "import json, sys; from ray_tpu.serve.prefix_cache import "
+        "hash_blocks; print(json.dumps(hash_blocks(json.loads("
+        "sys.argv[1]), 64)))"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", prog, json.dumps(tokens)],
+        capture_output=True, text=True, check=True,
+    )
+    assert json.loads(out.stdout) == hash_blocks(tokens, 64)
+
+
+# ---------------------------------------------------------------------------
+# block pool: refcounts + LRU
+# ---------------------------------------------------------------------------
+
+
+def _blk(i):
+    k = np.full((2, 4, 2, 2), i, np.float32)
+    return k, -k
+
+
+def test_pool_match_increfs_and_caps():
+    pool = BlockPool("m", block_tokens=4, max_blocks=8)
+    for d in ("a", "b"):
+        pool.insert(d, *_blk(1))
+    pool.release(["a", "b"])
+    held, ks, vs = pool.match(["a", "b", "x"], max_tokens=100)
+    assert held == ["a", "b"] and len(ks) == 2
+    assert pool.ref_count("a") == pool.ref_count("b") == 1
+    # chain walk stops at the first absent digest
+    held2, _, _ = pool.match(["a", "x", "b"], max_tokens=100)
+    assert held2 == ["a"] and pool.ref_count("a") == 2
+    # the cap: fewer than block_tokens usable tokens -> nothing matched
+    assert pool.match(["a"], max_tokens=3)[0] == []
+    pool.release(["a", "b"])
+    pool.release(["a"])
+    assert pool.ref_count("a") == 0
+    st = pool.stats()
+    assert st["hits"] == 3 and st["misses"] == 4
+    pool.close()
+
+
+def test_pool_lru_eviction_prefers_oldest_unreferenced():
+    pool = BlockPool("m", block_tokens=4, max_blocks=2)
+    for d in ("a", "b"):
+        pool.insert(d, *_blk(1))
+    pool.release(["a", "b"])
+    pool.match(["b"], max_tokens=100)  # touch b: a is now LRU
+    pool.release(["b"])
+    pool.insert("c", *_blk(2))
+    assert pool.resident() == 2
+    assert pool.ref_count("a") == 0 and pool.match(["a"], 100)[0] == []
+    assert pool.match(["b"], 100)[0] == ["b"]  # survived: recently used
+    assert pool.stats()["evictions"] == 1
+    pool.close()
+
+
+def test_pool_pinned_blocks_survive_overflow():
+    """Refs pin blocks: a pool over capacity with every block in use by
+    in-flight slots evicts nothing (and recovers once refs drop)."""
+    pool = BlockPool("m", block_tokens=4, max_blocks=2)
+    for d in ("a", "b", "c", "d"):
+        pool.insert(d, *_blk(1))  # all held: caller keeps one ref each
+    assert pool.resident() == 4 and pool.stats()["evictions"] == 0
+    pool.release(["a", "b", "c", "d"])
+    assert pool.resident() == 2  # drained back to capacity, LRU-first
+    assert pool.match(["d"], 100)[0] == ["d"]
+    pool.close()
+
+
+def test_pool_close_drops_everything_despite_refs():
+    pool = BlockPool("m", block_tokens=4, max_blocks=8)
+    pool.insert("a", *_blk(1))  # ref held
+    pool.close()
+    assert pool.resident() == 0
+    # closed pools neither match nor re-admit
+    pool.insert("b", *_blk(2))
+    assert pool.resident() == 0 and pool.match(["a"], 100)[0] == []
+
+
+# ---------------------------------------------------------------------------
+# engine-level: cached admission == cold prefill, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+
+    srv = LLMServer(LLMConfig(model_id="gpt2-tiny", max_batch_size=4))
+    yield srv
+    srv._stop.set()
+
+
+def test_cached_vs_cold_generations_bitwise_identical(engine):
+    """The acceptance property: a prompt admitted from pooled blocks +
+    tail prefill generates EXACTLY the tokens full prefill generates at
+    temperature=0 — including a block-aligned prompt (capped match) and
+    with the kill switch off."""
+    from ray_tpu.utils.config import config
+
+    rng = np.random.RandomState(11)
+    for n in (100, 128, 65):
+        prompt = [int(t) for t in rng.randint(0, 256, n)]
+        req = {"prompt_tokens": prompt, "max_new_tokens": 8,
+               "temperature": 0.0}
+        pool = engine._prefix_pool
+        h0 = pool.stats()["hits"]
+        cold = engine(req)["tokens"]
+        hot = engine(req)["tokens"]
+        assert hot == cold
+        assert pool.stats()["hits"] > h0  # second pass came from cache
+        config.set("serve_prefix_cache", False)
+        try:
+            off = engine(req)["tokens"]
+        finally:
+            config.set("serve_prefix_cache", True)
+        assert off == cold
+
+
+def test_refcounts_drain_under_slot_churn(engine):
+    """Concurrent requests sharing a prefix churn through the KV slots;
+    when they all finish, every pooled block's refcount is back to 0
+    (nothing leaks pins) and the shared blocks are still resident."""
+    rng = np.random.RandomState(12)
+    shared = [int(t) for t in rng.randint(0, 256, 64)]
+    solo = {}
+    for i in range(4):
+        solo[i] = engine({"prompt_tokens": shared + [i, i + 1],
+                          "max_new_tokens": 6, "temperature": 0.0})["tokens"]
+
+    results = [None] * 4
+
+    def call(i):
+        results[i] = engine({"prompt_tokens": shared + [i, i + 1],
+                             "max_new_tokens": 6, "temperature": 0.0})
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i in range(4):
+        assert results[i] is not None and results[i]["tokens"] == solo[i]
+    pool = engine._prefix_pool
+    assert pool.resident() > 0
+    with pool._lock:
+        assert all(b.refs == 0 for b in pool._blocks.values()), {
+            b.digest: b.refs for b in pool._blocks.values() if b.refs
+        }

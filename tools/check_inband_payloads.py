@@ -58,6 +58,10 @@ HOT_PATHS = (
     # activations move via channel writes — see CHANNEL_SEND_PATHS
     os.path.join("ray_tpu", "dag.py"),
     os.path.join("ray_tpu", "parallel", "pipeline.py"),
+    # disaggregated prefill→decode KV handoff: multi-MB KV rows per
+    # request must ride write_value's scatter-gather frames, never a
+    # packed in-band blob
+    os.path.join("ray_tpu", "serve", "kv_transfer.py"),
 )
 
 RPC_SEND_METHODS = {"call", "call_async", "call_oneway", "push",
@@ -71,6 +75,7 @@ CHANNEL_SEND_METHODS = {"write"}
 CHANNEL_SEND_PATHS = (
     os.path.join("ray_tpu", "dag.py"),
     os.path.join("ray_tpu", "parallel", "pipeline.py"),
+    os.path.join("ray_tpu", "serve", "kv_transfer.py"),
 )
 
 
