@@ -11,12 +11,13 @@ import os
 from typing import Dict, Tuple
 
 from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+from ray_tpu.utils.config import config
 
 
 def detect_node_resources_and_labels() -> Tuple[Dict[str, float], Dict[str, str]]:
     """Resources + labels this host contributes to the cluster."""
     resources: Dict[str, float] = {
-        "CPU": float(os.environ.get("RT_NUM_CPUS", os.cpu_count() or 1)),
+        "CPU": float(config.num_cpus or os.cpu_count() or 1),
         "memory": float(_total_memory_bytes()),
     }
     labels: Dict[str, str] = {}

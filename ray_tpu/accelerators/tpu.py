@@ -7,11 +7,16 @@ metadata pod-type/topology/worker-id detection (:450-563), the
 used for whole-slice gang scheduling (util/tpu.py:225,460).
 
 Discovery order for chip count:
-  1. RT_NUM_TPUS env (explicit override)
+  1. RT_NUM_TPUS (explicit override; the config.num_tpus dynamic flag)
   2. TPU_VISIBLE_CHIPS env (visibility restriction)
   3. /dev/accel* or /dev/vfio device files (local chips)
   4. GCE TPU-VM metadata server (accelerator-type → chips per host)
 None found → 0 (CPU-only node).
+
+The RT_* overrides ride utils/config dynamic flags (re-read per call:
+per-host inventory, never shipped in config snapshots).  The TPU_* /
+PALLAS_* names are external contracts with the TPU runtime and stay raw
+env reads.
 """
 
 from __future__ import annotations
@@ -21,6 +26,8 @@ import json
 import os
 import urllib.request
 from typing import List, Optional
+
+from ray_tpu.utils.config import config
 
 _GCE_METADATA_URL = "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
 
@@ -59,8 +66,8 @@ class TPUAcceleratorManager:
 
     @staticmethod
     def get_current_node_num_accelerators() -> int:
-        explicit = os.environ.get(NUM_TPUS_ENV)
-        if explicit is not None:
+        explicit = config.num_tpus
+        if explicit != "":
             return int(explicit)
         visible = os.environ.get(TPU_VISIBLE_CHIPS_ENV)
         if visible:
@@ -80,7 +87,7 @@ class TPUAcceleratorManager:
     @staticmethod
     def get_current_pod_type() -> Optional[str]:
         """e.g. 'v5litepod-16' — the accelerator-type of the slice."""
-        env = os.environ.get("RT_TPU_POD_TYPE")
+        env = config.tpu_pod_type
         if env:
             return env
         accel_type = _metadata("accelerator-type")
@@ -93,15 +100,15 @@ class TPUAcceleratorManager:
 
     @staticmethod
     def get_current_topology() -> Optional[str]:
-        env = os.environ.get("RT_TPU_TOPOLOGY")
+        env = config.tpu_topology
         if env:
             return env
         return _metadata("tpu-env") and _parse_tpu_env("TOPOLOGY") or None
 
     @staticmethod
     def get_current_worker_id() -> Optional[int]:
-        env = os.environ.get("RT_TPU_WORKER_ID")
-        if env is not None:
+        env = config.tpu_worker_id
+        if env != "":
             return int(env)
         wid = _metadata("agent-worker-number")
         if wid is not None:

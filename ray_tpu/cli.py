@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 from typing import List, Optional
 
@@ -210,8 +209,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="rt", description="ray_tpu cluster CLI"
     )
+    from ray_tpu.utils.config import config
+
     parser.add_argument(
-        "--address", default=os.environ.get("RT_ADDRESS"),
+        "--address", default=(config.address or None),
         help="control store host:port (default: $RT_ADDRESS)",
     )
     parser.add_argument("--json", action="store_true", dest="as_json")

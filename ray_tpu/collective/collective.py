@@ -209,9 +209,15 @@ def _await_keys(control, ns: str, keys: List[str],
                 timeout_s: float) -> Dict[str, Optional[bytes]]:
     """Concurrent server-side blocking kv_waits, with reconnect-and-
     reissue on transient control-store failures (the old poll loop's
-    retryable=True resilience, kept under the no-polling design)."""
+    retryable=True resilience, kept under the no-polling design).
+
+    The server caps each kv_wait at dispatch_wait_slice_s (so a barrier
+    fan-in can't strand the head's dispatcher pool); a None result
+    before OUR deadline means the slice expired, not that the key is
+    missing — re-issue until the key lands or time runs out."""
     import time as _time
 
+    from ray_tpu.utils.config import config
     from ray_tpu.utils.rpc import RpcConnectionError, RpcTimeout
 
     deadline = _time.monotonic() + timeout_s
@@ -219,21 +225,29 @@ def _await_keys(control, ns: str, keys: List[str],
     remaining_keys = list(keys)
     while remaining_keys:
         remaining = max(0.5, deadline - _time.monotonic())
+        wait_slice = min(remaining, float(config.dispatch_wait_slice_s))
         pending = {
-            k: control.call_async("kv_wait", ns=ns, key=k, wait_s=remaining)
+            k: control.call_async("kv_wait", ns=ns, key=k, wait_s=wait_slice)
             for k in remaining_keys
         }
         retry = []
+        reconnect = False
         for k, p in pending.items():
             try:
-                out[k] = p.wait(remaining + 30.0)
+                val = p.wait(wait_slice + 30.0)
             except (RpcConnectionError, RpcTimeout):
                 if _time.monotonic() < deadline:
                     retry.append(k)
+                    reconnect = True
                 else:
                     out[k] = None
+                continue
+            if val is None and _time.monotonic() < deadline:
+                retry.append(k)  # server slice expired — re-issue
+            else:
+                out[k] = val
         remaining_keys = retry
-        if retry:
+        if reconnect:
             _time.sleep(0.2)  # let the client reconnect
     return out
 

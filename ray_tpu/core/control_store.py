@@ -718,7 +718,14 @@ class ControlStore:
         """Block server-side until the key exists (or timeout); returns
         the value or None. The collective tier's rendezvous primitive:
         one blocking RPC replaces a client-side poll loop (the round-2
-        O(n^2)-polling weakness)."""
+        O(n^2)-polling weakness).
+
+        The server never honors the caller's full deadline in one call:
+        the wait is capped at dispatch_wait_slice_s so a fan-in of
+        blocked waiters can't strand the whole dispatcher pool (clients
+        re-issue slices until their own deadline — see
+        collective._recv_either)."""
+        wait_s = min(wait_s, float(config.dispatch_wait_slice_s))
         deadline = time.monotonic() + wait_s
         with self._lock:
             while True:
@@ -1292,6 +1299,8 @@ class ControlStore:
             return self._public_actor(actor_id)
 
     def rpc_wait_actor_alive(self, conn, actor_id: str, wait_s: float = 60.0):
+        # sliced server-side: clients loop (worker._resolve_actor_address)
+        wait_s = min(wait_s, float(config.dispatch_wait_slice_s))
         deadline = time.monotonic() + wait_s
         while time.monotonic() < deadline:
             with self._lock:
@@ -1573,6 +1582,8 @@ class ControlStore:
             return dict(pg) if pg else None
 
     def rpc_wait_placement_group(self, conn, pg_id: str, wait_s: float = 60.0):
+        # sliced server-side: clients loop (placement.PlacementGroup.wait)
+        wait_s = min(wait_s, float(config.dispatch_wait_slice_s))
         deadline = time.monotonic() + wait_s
         while time.monotonic() < deadline:
             with self._lock:

@@ -715,7 +715,7 @@ class NodeAgent:
         from ray_tpu.core import runtime_env as runtime_env_mod
 
         env_hash = runtime_env_mod.env_hash(runtime_env)
-        return self._lease_wait(
+        return self._lease_wait(  # rtlint: ignore[dispatcher-block] the agent dispatch pool spawns per-request threads (never queues), so a parked lease holds no shared thread; slicing would double scheduler RPCs on the grant hot path
             resources, bundle, deadline, kind, strategy, owner_conn,
             runtime_env, env_hash,
         )

@@ -31,7 +31,7 @@ def main() -> None:
 
     from ray_tpu.utils.config import config
 
-    snapshot = os.environ.get("RT_CONFIG_SNAPSHOT")
+    snapshot = os.environ.get("RT_CONFIG_SNAPSHOT")  # rtlint: ignore[config-hygiene] boot protocol: the snapshot must be read raw BEFORE config is populated from it
     if snapshot:
         config.load_snapshot(snapshot)
 

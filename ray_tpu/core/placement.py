@@ -10,13 +10,34 @@ mirroring gcs_placement_group_scheduler.h:115-117.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.core.exceptions import PlacementGroupError
 from ray_tpu.utils import serialization
+from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import PlacementGroupID
 
 VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+_TERMINAL_PG_STATES = ("CREATED", "REMOVED")
+
+
+def _wait_pg(control, pg_id: str, deadline: float):
+    """Re-issue sliced wait_placement_group calls until the PG reaches a
+    terminal state or the deadline passes.  The server caps each call at
+    dispatch_wait_slice_s (it never holds a dispatcher thread for our
+    whole deadline), so one long wait is a client-side loop now."""
+    slice_s = float(config.dispatch_wait_slice_s)
+    while True:
+        info = control.call(
+            "wait_placement_group", pg_id=pg_id, wait_s=slice_s,
+            timeout_s=slice_s + 30.0,
+        )
+        if info is None or info.get("state") in _TERMINAL_PG_STATES:
+            return info
+        if time.monotonic() >= deadline:
+            return info
 
 
 class PlacementGroup:
@@ -42,9 +63,8 @@ class PlacementGroup:
         ref = ObjectRef(oid, w.address)
 
         def waiter():
-            info = w.control.call(
-                "wait_placement_group", pg_id=self.id_hex,
-                wait_s=3600.0, timeout_s=3700.0,
+            info = _wait_pg(
+                w.control, self.id_hex, time.monotonic() + 3600.0
             )
             if info and info.get("state") == "CREATED":
                 w.memory_store.put(oid, serialization.pack(self))
@@ -64,9 +84,8 @@ class PlacementGroup:
         from ray_tpu.core import worker as worker_mod
 
         w = worker_mod.global_worker()
-        info = w.control.call(
-            "wait_placement_group", pg_id=self.id_hex, wait_s=timeout_seconds,
-            timeout_s=timeout_seconds + 30.0,
+        info = _wait_pg(
+            w.control, self.id_hex, time.monotonic() + timeout_seconds
         )
         return bool(info and info.get("state") == "CREATED")
 

@@ -196,7 +196,7 @@ def ensure_pip_env(packages) -> str:
 
     packages = sorted(str(p) for p in packages)
     key = _content_digest(json.dumps(packages).encode())[:16]
-    env_dir = os.path.join("/tmp/ray_tpu/pip_envs", key)
+    env_dir = os.path.join(str(config.temp_dir), "pip_envs", key)
     python = os.path.join(env_dir, "bin", "python")
     marker = os.path.join(env_dir, ".rt_ready")
     with _pip_lock:

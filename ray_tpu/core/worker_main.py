@@ -31,7 +31,7 @@ def main() -> None:
 
     from ray_tpu.utils.config import config
 
-    snapshot = os.environ.get("RT_CONFIG_SNAPSHOT")
+    snapshot = os.environ.get("RT_CONFIG_SNAPSHOT")  # rtlint: ignore[config-hygiene] boot protocol: the snapshot must be read raw BEFORE config is populated from it
     if snapshot:
         config.load_snapshot(snapshot)
 
@@ -46,7 +46,7 @@ def main() -> None:
     )
     w.worker_kind = args.kind
     w.boot_env_hash = args.env_hash
-    boot_env = os.environ.get("RT_BOOT_ENV")
+    boot_env = os.environ.get("RT_BOOT_ENV")  # rtlint: ignore[config-hygiene] boot protocol: set per-process by the node agent at spawn, not a cluster flag
     if boot_env:
         # env-keyed pool: this worker is dedicated to one runtime env —
         # apply it for the process's whole life BEFORE registering, so a

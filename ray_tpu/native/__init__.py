@@ -64,7 +64,9 @@ def _build(name: str) -> str | None:
 
 def load(name: str) -> ctypes.CDLL | None:
     """Load (building if needed) a native core; None → use the fallback."""
-    if os.environ.get("RT_NATIVE", "1") == "0":
+    from ray_tpu.utils.config import config
+
+    if not config.native:
         return None
     with _lock:
         if name in _libs:

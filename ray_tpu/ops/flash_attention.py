@@ -212,19 +212,17 @@ def _pick_block(t: int, target: int) -> int:
     return t
 
 
-import os
-
-
 def _block_sizes(T: int):
     """(bq, bk) for sequence length T. 1024x1024 measured fastest on v5e
     for the train step (PROFILE.md): the [bq, bk] f32 score tile is 4MB of
     VMEM, large q tiles amortize the [bq, D]-contraction's half-width MXU
     occupancy (D=64), and at T<=1024 the kernel runs the one-shot
     softmax path (single K block, no online-softmax carries). VMEM stays
-    bounded for long sequences (T=128k runs at the same tile size)."""
-    tq = int(os.environ.get("RT_FLASH_BQ", "1024"))
-    tk = int(os.environ.get("RT_FLASH_BK", "1024"))
-    return _pick_block(T, tq), _pick_block(T, tk)
+    bounded for long sequences (T=128k runs at the same tile size).
+    RT_FLASH_BQ/BK (dynamic flags) override per process for sweeps."""
+    from ray_tpu.utils.config import config
+
+    return _pick_block(T, int(config.flash_bq)), _pick_block(T, int(config.flash_bk))
 
 
 def _fold(x):  # [B, T, H, D] -> [B*H, T, D]

@@ -597,7 +597,11 @@ class CompiledPipeline:
             # /dev/shm/rtchan_* debris from a failed compile
             for h in parked_cmds:
                 try:
-                    open_channel(h, "write").write(_STOP, timeout_s=1.0)
+                    wch = open_channel(h, "write")
+                    try:
+                        wch.write(_STOP, timeout_s=1.0)
+                    finally:
+                        wch.close()
                 except Exception:  # noqa: BLE001 — best-effort
                     pass
             for ch in self._shm_channels:

@@ -252,7 +252,10 @@ def send_kv(handle: Dict[str, Any], shipment: Dict[str, Any],
     from ray_tpu.core import channels
 
     chan = channels.open_channel(handle, "write")
-    chan.write_value(shipment, timeout_s=timeout_s)
+    try:
+        chan.write_value(shipment, timeout_s=timeout_s)
+    finally:
+        chan.close()
 
 
 def recv_kv(reader, timeout_s: float = 30.0) -> Dict[str, Any]:

@@ -53,17 +53,20 @@ class TrainWorker:
     ) -> List[Dict[str, Any]]:
         """Execute the user train loop; returns this rank's reports."""
         from ray_tpu.train import context as ctx_mod
+        from ray_tpu.utils.config import config
 
         # Multi-host TPU: join this worker into the group's JAX runtime
         # before any jax use in the train fn (parity: reference JaxBackend
         # _setup_jax_distributed_environment, train/v2/jax/config.py:31).
-        if os.environ.get("RT_XLA_GROUP"):
+        # RT_XLA_* arrive via apply_env() on this actor; the dynamic flags
+        # re-read the process env on each access.
+        if config.xla_group:
             from ray_tpu.collective.xla_group import initialize_xla_group
 
             initialize_xla_group(
-                os.environ["RT_XLA_GROUP"],
-                int(os.environ["RT_XLA_RANK"]),
-                int(os.environ["RT_XLA_WORLD"]),
+                config.xla_group,
+                int(config.xla_rank),
+                int(config.xla_world),
             )
 
         train_fn = serialization.loads(train_fn_blob)

@@ -20,7 +20,9 @@ def _path() -> str:
 
 
 def enabled() -> bool:
-    return os.environ.get("RT_USAGE_STATS_ENABLED", "1") not in ("0", "false")
+    from ray_tpu.utils.config import config
+
+    return bool(config.usage_stats_enabled)
 
 
 def record(event: str, **fields: Any) -> None:

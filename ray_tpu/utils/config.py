@@ -18,19 +18,40 @@ _ENV_PREFIX = "RT_"
 
 
 class _Flag:
-    __slots__ = ("name", "default", "parser", "value", "overridden")
+    __slots__ = ("name", "default", "parser", "value", "overridden",
+                 "dynamic")
 
-    def __init__(self, name: str, default: Any, parser: Callable[[str], Any]):
+    def __init__(self, name: str, default: Any, parser: Callable[[str], Any],
+                 dynamic: bool = False):
         self.name = name
         self.default = default
         self.parser = parser
         self.overridden = False
-        env = os.environ.get(_ENV_PREFIX + name.upper())
+        self.dynamic = dynamic
+        env = None if dynamic else os.environ.get(
+            _ENV_PREFIX + name.upper()
+        )
         if env is not None:
             self.value = parser(env)
             self.overridden = True
         else:
             self.value = default
+
+    def read(self) -> Any:
+        """Current value.  Static flags resolved env once at define time;
+        dynamic flags re-read the environment on every access (per-host /
+        per-process values — a worker's XLA rank, a node's chip count —
+        that land in os.environ after import, e.g. via runtime-env
+        ``apply_env``).  An explicit ``config.set`` still wins."""
+        if not self.dynamic or self.overridden:
+            return self.value
+        env = os.environ.get(_ENV_PREFIX + self.name.upper())
+        if env is None or env == "":
+            return self.default
+        try:
+            return self.parser(env)
+        except ValueError:
+            return self.default
 
 
 def _parse_bool(s: str) -> bool:
@@ -44,7 +65,7 @@ class Config:
         self._flags: Dict[str, _Flag] = {}
         self._lock = threading.Lock()
 
-    def define(self, name: str, default: Any) -> None:
+    def define(self, name: str, default: Any, dynamic: bool = False) -> None:
         if isinstance(default, bool):
             parser: Callable[[str], Any] = _parse_bool
         elif isinstance(default, int):
@@ -55,10 +76,10 @@ class Config:
             parser = str
         with self._lock:
             if name not in self._flags:
-                self._flags[name] = _Flag(name, default, parser)
+                self._flags[name] = _Flag(name, default, parser, dynamic)
 
     def get(self, name: str) -> Any:
-        return self._flags[name].value
+        return self._flags[name].read()
 
     def set(self, name: str, value: Any) -> None:
         with self._lock:
@@ -66,9 +87,13 @@ class Config:
             self._flags[name].overridden = True
 
     def snapshot(self) -> str:
-        """Serialize current values (for head → node distribution)."""
+        """Serialize current values (for head → node distribution).
+        Dynamic flags are per-host/per-process and never ship: the
+        head's chip count or XLA rank must not overwrite a node's."""
         with self._lock:
-            return json.dumps({k: f.value for k, f in self._flags.items()})
+            return json.dumps({
+                k: f.value for k, f in self._flags.items() if not f.dynamic
+            })
 
     def load_snapshot(self, payload: str) -> None:
         """Apply a head-node snapshot; local env overrides still win."""
@@ -76,12 +101,13 @@ class Config:
         with self._lock:
             for k, v in data.items():
                 flag = self._flags.get(k)
-                if flag is not None and not flag.overridden:
+                if flag is not None and not flag.overridden \
+                        and not flag.dynamic:
                     flag.value = v
 
     def __getattr__(self, name: str) -> Any:
         try:
-            return self._flags[name].value
+            return self._flags[name].read()
         except KeyError:
             raise AttributeError(name) from None
 
@@ -246,3 +272,35 @@ config.define("serve_disagg", True)
 # Budget for one prefill+transfer leg; a SIGKILLed prefill replica
 # surfaces as a request failure within this, never a decode hang.
 config.define("serve_disagg_timeout_s", 60.0)
+# Server-side slice cap for blocking rpc_* waits on the head (kv_wait,
+# wait_actor_alive, wait_placement_group): a handler never holds a
+# dispatcher thread longer than this per call — clients re-issue slices
+# until their own deadline (tools/rtlint dispatcher-block pass).
+config.define("dispatch_wait_slice_s", 2.0)
+
+# --- Per-host / per-process flags (dynamic) ----------------------------
+# Re-read from the environment on every access and EXCLUDED from
+# snapshot()/load_snapshot(): these describe the host or the process
+# (chip inventory, XLA rank injected by the train controller via
+# runtime-env apply_env), so a head-side value must never ship to nodes.
+config.define("address", "", dynamic=True)
+config.define("num_cpus", 0.0, dynamic=True)
+# TPU inventory overrides (accelerators/tpu.py): "" = autodetect from
+# the metadata server / PCI scan.
+config.define("num_tpus", "", dynamic=True)
+config.define("tpu_pod_type", "", dynamic=True)
+config.define("tpu_topology", "", dynamic=True)
+config.define("tpu_worker_id", "", dynamic=True)
+# SPMD process-group coordinates the train controller injects into each
+# TrainWorker's env between boot and run() (train/worker_group.py).
+config.define("xla_group", "", dynamic=True)
+config.define("xla_rank", "", dynamic=True)
+config.define("xla_world", "", dynamic=True)
+# Flash-attention block geometry (ops/flash_attention.py); tests tune
+# these per-case via monkeypatch.setenv.
+config.define("flash_bq", 1024, dynamic=True)
+config.define("flash_bk", 1024, dynamic=True)
+config.define("usage_stats_enabled", True, dynamic=True)
+# Native (C/rust) data-plane toggle (native/__init__.py): RT_NATIVE=0
+# forces the pure-python fallbacks.
+config.define("native", True, dynamic=True)

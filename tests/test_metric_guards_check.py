@@ -7,24 +7,44 @@ import os
 import sys
 import textwrap
 
-TOOLS = os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
 sys.path.insert(0, TOOLS)
+sys.path.insert(0, REPO)
 
 from check_metric_guards import (  # noqa: E402
     check_source, iter_default_files, check_file,
 )
+from tools.rtlint import check_source as rtlint_check  # noqa: E402
 
 
 def test_package_stamps_are_guarded():
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    violations = []
-    for path in iter_default_files(root):
-        violations.extend(check_file(path))
-    assert not violations, "\n".join(violations)
+    for path in iter_default_files(REPO):
+        rel = os.path.relpath(path, REPO)
+        with open(path) as f:
+            src = f.read()
+        findings = [
+            f for f in rtlint_check(src, rel, pass_ids=["metric-guards"])
+            if not f.suppressed
+        ]
+        assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_legacy_shim_api_preserved():
+    violations = check_source(
+        "def route(dep):\n"
+        "    core_metrics.serve_router_requests.inc()\n"
+    )
+    assert len(violations) == 1
+    assert isinstance(violations[0], str)
+    assert callable(check_file)
 
 
 def _check(body: str):
-    return check_source(textwrap.dedent(body))
+    findings = rtlint_check(
+        textwrap.dedent(body), pass_ids=["metric-guards"]
+    )
+    return [f.message for f in findings if not f.suppressed]
 
 
 def test_flags_unguarded_counter_inc():

@@ -1,0 +1,179 @@
+"""rtlint engine + whole-repo gate (tier-1).
+
+The gate: ``python -m tools.rtlint ray_tpu --json`` must exit 0 with
+zero unsuppressed findings — every pass (wal-choke, inband-payloads,
+metric-guards, blocking-async, dispatcher-block, resource-leak,
+config-hygiene) over the whole package, every suppression carrying a
+written reason.  Plus engine contracts: suppressions REQUIRE a reason,
+the mtime cache serves and invalidates correctly, and --changed scopes
+to the git diff."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.rtlint import check_source, run_paths  # noqa: E402
+from tools.rtlint.engine import changed_files  # noqa: E402
+from tools.rtlint.passes import REGISTRY, get_pass  # noqa: E402
+
+
+def test_ray_tpu_is_lint_clean():
+    """The repo gate: zero unsuppressed findings across every pass."""
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", "ray_tpu",
+         "--json", "--no-cache"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    report = json.loads(res.stdout)
+    assert report["findings"] == [], json.dumps(
+        report["findings"], indent=2
+    )
+    # every accepted suppression must carry its written reason
+    for sup in report["suppressed"]:
+        assert sup["reason"].strip(), sup
+
+
+def test_registry_has_all_passes():
+    ids = {p.id for p in REGISTRY}
+    assert ids == {
+        "wal-choke", "inband-payloads", "metric-guards",
+        "blocking-async", "dispatcher-block", "resource-leak",
+        "config-hygiene",
+    }
+    for pid in ids:
+        assert get_pass(pid).id == pid
+
+
+def test_suppression_requires_reason():
+    # the ignore comment is assembled at runtime so THIS file's own lint
+    # run does not see a literal reasonless suppression
+    src = textwrap.dedent("""
+        async def handle(self):
+            time.sleep(1.0)  # MARK[blocking-async]
+    """).replace("MARK", "rtlint: ignore")
+    findings = check_source(src, pass_ids=["blocking-async"])
+    # the reasonless ignore does NOT suppress, and is itself reported
+    live = [f for f in findings if not f.suppressed]
+    assert {f.pass_id for f in live} == {"blocking-async", "suppression"}
+    assert any("no reason" in f.message for f in live)
+
+
+def test_stale_reasonless_ignore_is_reported():
+    src = "x = 1  # MARK[resource-leak]\n".replace("MARK", "rtlint: ignore")
+    findings = check_source(src, pass_ids=["resource-leak"])
+    assert len(findings) == 1
+    assert findings[0].pass_id == "suppression"
+
+
+def test_suppression_with_reason_records_it():
+    src = textwrap.dedent("""
+        async def handle(self):
+            time.sleep(1.0)  # rtlint: ignore[blocking-async] warmup jitter, measured harmless
+    """)
+    findings = check_source(src, pass_ids=["blocking-async"])
+    assert len(findings) == 1
+    assert findings[0].suppressed
+    assert "warmup jitter" in findings[0].reason
+
+
+def test_parse_failure_is_a_finding():
+    findings = check_source("def broken(:\n", pass_ids=["blocking-async"])
+    assert len(findings) == 1 and findings[0].pass_id == "parse"
+
+
+_LEAKY = textwrap.dedent("""
+    def notify(h):
+        open_channel(h, "write").write(b"stop")
+""")
+
+
+def _tmp_tree(tmp_path):
+    pkg = tmp_path / "ray_tpu"
+    pkg.mkdir()
+    target = pkg / "leaky.py"
+    target.write_text(_LEAKY)
+    cache = tmp_path / ".cache.json"
+    return target, cache
+
+
+def _run_tmp(tmp_path, cache):
+    return run_paths(
+        ["ray_tpu"], root=str(tmp_path), use_cache=True,
+        cache_path=str(cache), project_checks=False,
+    )
+
+
+def test_cache_serves_and_invalidates(tmp_path):
+    target, cache = _tmp_tree(tmp_path)
+
+    first = _run_tmp(tmp_path, cache)
+    assert first["cache_hits"] == 0
+    assert len(first["findings"]) == 1
+
+    # tamper with the stored message: a second run must serve the
+    # tampered copy — proof the result came from the cache, not a re-lint
+    data = json.loads(cache.read_text())
+    ent = data["files"][os.path.join("ray_tpu", "leaky.py")]
+    ent["findings"][0]["message"] = "FROM-THE-CACHE"
+    cache.write_text(json.dumps(data))
+
+    second = _run_tmp(tmp_path, cache)
+    assert second["cache_hits"] == 1
+    assert second["findings"][0].message == "FROM-THE-CACHE"
+
+    # touching the file invalidates its entry: the real finding is back
+    st = target.stat()
+    os.utime(target, (st.st_atime, st.st_mtime + 10))
+    third = _run_tmp(tmp_path, cache)
+    assert third["cache_hits"] == 0
+    assert "used without a handle" in third["findings"][0].message
+
+
+def test_cache_rejects_foreign_fingerprint(tmp_path):
+    target, cache = _tmp_tree(tmp_path)
+    _run_tmp(tmp_path, cache)
+
+    # an engine/pass edit changes the fingerprint; simulate by corrupting
+    # the recorded one — every entry must be recomputed
+    data = json.loads(cache.read_text())
+    data["fingerprint"] = "stale"
+    cache.write_text(json.dumps(data))
+
+    rerun = _run_tmp(tmp_path, cache)
+    assert rerun["cache_hits"] == 0
+    assert len(rerun["findings"]) == 1
+
+
+def test_changed_files_lists_existing_python():
+    rels = changed_files(REPO)
+    assert isinstance(rels, list)
+    for rel in rels:
+        assert rel.endswith(".py")
+        assert os.path.exists(os.path.join(REPO, rel))
+
+
+def test_cli_changed_mode_runs():
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", "--changed", "--json",
+         "--no-cache"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode in (0, 1), res.stdout + res.stderr
+    report = json.loads(res.stdout)
+    assert res.returncode == 0, json.dumps(report["findings"], indent=2)
+
+
+def test_cli_list_passes():
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.rtlint", "--list-passes"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert res.returncode == 0
+    for pid in ("wal-choke", "dispatcher-block", "config-hygiene"):
+        assert pid in res.stdout

@@ -1,0 +1,4 @@
+"""Repo tooling (static checkers, profiling experiments).
+
+A package so ``python -m tools.rtlint`` works from the repo root.
+"""

@@ -1,271 +1,38 @@
 #!/usr/bin/env python
-"""Static check: hot-path RPC calls must not carry raw packed payloads
-in-band.
+"""Shim: the in-band payload checker now lives in the rtlint framework
+as the ``inband-payloads`` pass (tools/rtlint/passes/inband_payloads.py).
+This module keeps the historical entry points — ``check_source`` /
+``check_file`` / ``main``, ``HOT_PATHS``, ``send_methods_for`` and the
+rule constants — so existing tests and scripts keep working.
 
-The zero-copy data plane (utils/rpc.py multi-segment frames) only stays
-zero-copy if bulk payloads reach the RPC layer as out-of-band-capable
-values: ndarrays (pickle-5 splits them automatically) or packed frames
-wrapped in ``serialization.Frame`` / ``serialization.maybe_frame``. A
-call site that passes ``serialization.pack(...)`` / ``dumps(...)`` /
-``pack_parts(...)`` output (or ``.tobytes()`` / ``bytes(view)``) straight
-into an RPC send re-introduces the in-band memcpy this PR removed — and
-nothing would fail, it would just be slow. This checker walks the
-hot-path modules' ASTs and flags:
-
-1. a raw-serializer call (``serialization.pack/dumps/pack_parts``,
-   ``*.tobytes()``, ``bytes(<something>)``) appearing DIRECTLY as an
-   argument of an RPC send (``.call`` / ``.call_async`` /
-   ``.call_oneway`` / ``.push`` / ``.push_encoded`` / ``reply``);
-2. the same through a local alias: a name assigned from a raw
-   serializer inside the function and later passed to an RPC send
-   (alias propagation to a fixpoint, like check_wal_choke.py);
-3. the same in a ``return`` of an RPC REPLY producer — a function named
-   ``rpc_*`` or in DIRECT_REPLY_FNS (the serve replicas'
-   ``handle_request_direct``): its return value IS the RPC response
-   payload, so a raw packed blob returned there rides the wire in-band
-   exactly like a dirty send argument. This covers the serve
-   proxy→replica hot path, where response bodies ≥32 KiB must travel
-   as out-of-band segments (wrap in ``serialization.maybe_frame``).
-
-Wrapping in ``serialization.Frame(...)`` / ``maybe_frame(...)`` cleans a
-value. Control-plane modules may pickle in-band freely — only the
-modules in HOT_PATHS are checked. A line may opt out with a
-``# inband: ok`` comment (e.g. the WAL append, where durability needs
-one contiguous record). Run directly or via
-tests/test_inband_check.py (tier-1).
+Prefer ``python -m tools.rtlint ray_tpu`` (all passes, cached) or
+``python -m tools.rtlint --pass inband-payloads`` for new workflows.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Set
 
-HOT_PATHS = (
-    os.path.join("ray_tpu", "core", "worker.py"),
-    os.path.join("ray_tpu", "core", "node_agent.py"),
-    os.path.join("ray_tpu", "serve", "proxy.py"),
-    os.path.join("ray_tpu", "serve", "replica.py"),
-    os.path.join("ray_tpu", "serve", "router.py"),
-    # collective transport: ring chunk deliveries must pass ndarrays /
-    # Frame-wrapped values so they ride as out-of-band segments; only
-    # the KV fallback (which stores contiguous blobs by design) and the
-    # ~100 B rendezvous records may pack in-band (opted out per line)
-    os.path.join("ray_tpu", "collective", "p2p.py"),
-    os.path.join("ray_tpu", "collective", "collective.py"),
-    # compiled-graph / compiled-pipeline exec loops: microbatch
-    # activations move via channel writes — see CHANNEL_SEND_PATHS
-    os.path.join("ray_tpu", "dag.py"),
-    os.path.join("ray_tpu", "parallel", "pipeline.py"),
-    # disaggregated prefill→decode KV handoff: multi-MB KV rows per
-    # request must ride write_value's scatter-gather frames, never a
-    # packed in-band blob
-    os.path.join("ray_tpu", "serve", "kv_transfer.py"),
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.rtlint.passes.inband_payloads import (  # noqa: E402,F401
+    CHANNEL_SEND_METHODS,
+    CHANNEL_SEND_PATHS,
+    DIRECT_REPLY_FNS,
+    HOT_PATHS,
+    OPT_OUT_MARK,
+    PASS,
+    RAW_SERIALIZERS,
+    RPC_SEND_METHODS,
+    WRAPPERS,
+    check_file,
+    check_source,
+    main,
+    send_methods_for,
 )
-
-RPC_SEND_METHODS = {"call", "call_async", "call_oneway", "push",
-                    "push_encoded", "reply"}
-# In the compiled exec-loop modules a channel ``.write(pack(...))`` is
-# the same in-band join-copy an RPC send would be: activations ≥32 KiB
-# must ride ``write_value``/``write_views`` (scatter-gather straight
-# into the shm slot; Frame-wrapped multiseg segments on the RpcChannel
-# tier). Only the tiny _STOP sentinel goes through raw ``.write``.
-CHANNEL_SEND_METHODS = {"write"}
-CHANNEL_SEND_PATHS = (
-    os.path.join("ray_tpu", "dag.py"),
-    os.path.join("ray_tpu", "parallel", "pipeline.py"),
-    os.path.join("ray_tpu", "serve", "kv_transfer.py"),
-)
-
-
-def send_methods_for(filename: str):
-    """The send-method set a file is checked against: RPC sends
-    everywhere, plus channel writes in the exec-loop modules."""
-    if filename.endswith(CHANNEL_SEND_PATHS):
-        return RPC_SEND_METHODS | CHANNEL_SEND_METHODS
-    return RPC_SEND_METHODS
-RAW_SERIALIZERS = {"pack", "dumps", "pack_parts"}
-WRAPPERS = {"Frame", "maybe_frame"}
-# reply producers: the return value travels as the RPC response payload
-DIRECT_REPLY_FNS = {"handle_request_direct"}
-OPT_OUT_MARK = "# inband: ok"
-
-
-def _call_attr(node: ast.AST) -> str:
-    """Method name of a Call through an attribute, else ''. """
-    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
-        return node.func.attr
-    return ""
-
-
-def _is_raw_serializer_call(node: ast.AST) -> bool:
-    """serialization.pack(...) / dumps(...) / pack_parts(...) /
-    x.tobytes() / bytes(...)."""
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        if fn.attr in RAW_SERIALIZERS or fn.attr == "tobytes":
-            return True
-    if isinstance(fn, ast.Name) and fn.id == "bytes" and node.args:
-        return True
-    return False
-
-
-def _is_wrapper_call(node: ast.AST) -> bool:
-    return isinstance(node, ast.Call) and _call_attr(node) in WRAPPERS or (
-        isinstance(node, ast.Call)
-        and isinstance(node.func, ast.Name)
-        and node.func.id in WRAPPERS
-    )
-
-
-def _raw_aliases(fn: ast.AST) -> Set[str]:
-    """Names assigned (possibly transitively) from a raw serializer call
-    within one function, to a fixpoint. A name reassigned from a wrapper
-    is NOT cleaned retroactively — one dirty binding taints the name for
-    the whole function (static over-approximation, opt out per line)."""
-    aliases: Set[str] = set()
-    changed = True
-    while changed:
-        changed = False
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Assign):
-                continue
-            value = node.value
-            dirty = _is_raw_serializer_call(value) or (
-                isinstance(value, ast.Name) and value.id in aliases
-            )
-            if not dirty:
-                continue
-            for t in node.targets:
-                for sub in ast.walk(t):
-                    if (
-                        isinstance(sub, ast.Name)
-                        and isinstance(sub.ctx, ast.Store)
-                        and sub.id not in aliases
-                    ):
-                        aliases.add(sub.id)
-                        changed = True
-    return aliases
-
-
-def _payload_args(call: ast.Call):
-    for a in call.args:
-        yield a
-    for kw in call.keywords:
-        yield kw.value
-
-
-def _dirty_payloads(call: ast.Call, aliases: Set[str]):
-    """Raw-serializer expressions reaching an RPC send call's arguments,
-    at any nesting depth — but never looking INSIDE a wrapper call."""
-    yield from _dirty_payloads_expr(list(_payload_args(call)), aliases)
-
-
-def _dirty_payloads_expr(root, aliases: Set[str]):
-    """Raw-serializer expressions anywhere in an expression (or list of
-    expressions), never looking INSIDE a wrapper call."""
-    stack = list(root) if isinstance(root, list) else [root]
-    while stack:
-        node = stack.pop()
-        if _is_wrapper_call(node):
-            continue  # wrapped payloads are clean, whatever is inside
-        if _is_raw_serializer_call(node):
-            yield node
-            continue
-        if isinstance(node, ast.Name) and node.id in aliases:
-            yield node
-            continue
-        for child in ast.iter_child_nodes(node):
-            stack.append(child)
-
-
-def check_source(src: str, filename: str = "<source>",
-                 send_methods=None) -> List[str]:
-    if send_methods is None:
-        send_methods = send_methods_for(filename)
-    tree = ast.parse(src, filename=filename)
-    lines = src.splitlines()
-    violations: List[str] = []
-
-    def opted_out(lineno: int) -> bool:
-        return 0 < lineno <= len(lines) and OPT_OUT_MARK in lines[lineno - 1]
-
-    functions = [
-        n for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    ]
-    for fn in functions:
-        aliases = _raw_aliases(fn)
-        for node in ast.walk(fn):
-            if _call_attr(node) not in send_methods:
-                continue
-            for dirty in _dirty_payloads(node, aliases):
-                if opted_out(node.lineno) or opted_out(dirty.lineno):
-                    continue
-                what = (
-                    f"alias {dirty.id!r}" if isinstance(dirty, ast.Name)
-                    else "serializer output"
-                )
-                violations.append(
-                    f"{filename}:{node.lineno}: in {fn.name}(): raw "
-                    f"in-band payload ({what}) passed to "
-                    f".{_call_attr(node)}() — wrap in serialization.Frame/"
-                    f"maybe_frame or pass the value itself"
-                )
-        if not (fn.name.startswith("rpc_") or fn.name in DIRECT_REPLY_FNS):
-            continue
-        # reply producers: returns are response payloads (rule 3). Only
-        # THIS function's returns — nested defs (closures, streaming
-        # generators) reply through other channels.
-        nested = {
-            inner
-            for outer in ast.walk(fn)
-            if isinstance(outer, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and outer is not fn
-            for inner in ast.walk(outer)
-        }
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Return) or node.value is None:
-                continue
-            if node in nested:
-                continue
-            for dirty in _dirty_payloads_expr(node.value, aliases):
-                if opted_out(node.lineno) or opted_out(dirty.lineno):
-                    continue
-                what = (
-                    f"alias {dirty.id!r}" if isinstance(dirty, ast.Name)
-                    else "serializer output"
-                )
-                violations.append(
-                    f"{filename}:{node.lineno}: in {fn.name}(): raw "
-                    f"in-band payload ({what}) returned as an RPC reply "
-                    f"— wrap in serialization.Frame/maybe_frame"
-                )
-    return violations
-
-
-def check_file(path: str) -> List[str]:
-    with open(path) as f:
-        return check_source(f.read(), filename=path)
-
-
-def main(argv: List[str]) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    paths = argv[1:] or [os.path.join(repo, p) for p in HOT_PATHS]
-    violations: List[str] = []
-    for p in paths:
-        violations.extend(check_file(p))
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"{len(violations)} in-band payload violation(s)")
-        return 1
-    print(f"{len(paths)} hot-path file(s): no in-band bulk payloads")
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv))

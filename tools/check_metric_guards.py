@@ -1,215 +1,34 @@
 #!/usr/bin/env python
-"""Static check: every observability stamp site is kill-switch guarded.
+"""Shim: the metric-guard checker now lives in the rtlint framework as
+the ``metric-guards`` pass (tools/rtlint/passes/metric_guards.py).  This
+module keeps the historical entry points — ``check_source`` /
+``check_file`` / ``iter_default_files`` / ``main`` and the rule
+constants — so existing tests and scripts keep working.
 
-The observability hot-path contract (ray_tpu/observability/) is ONE
-invariant: with ``RT_OBSERVABILITY_ENABLED=0`` / ``RT_TRACE_EVENTS=0``,
-every metric update and trace stamp in the data plane reduces to a
-single module-attribute check — no dict building, no time syscalls, no
-ring appends. That holds only if every call site guards itself with the
-module-level flag (``if core_metrics.ENABLED:`` / ``if
-tracing.ENABLED:``); one unguarded stamp silently re-adds overhead the
-switches promise to remove.
-
-This checker walks the package's AST and flags:
-
-1. ``core_metrics.<instrument>.inc/set/observe(...)`` calls not
-   lexically inside an ``if`` whose test mentions
-   ``core_metrics.ENABLED``;
-2. ``tracing.emit(...)`` and ``*._append_task_event(...)`` calls not
-   inside an ``if`` mentioning ``tracing.ENABLED``.
-
-Compound tests count (``if tid and tracing.ENABLED:``, ``if
-core_metrics.ENABLED or tracing.ENABLED:``), as does the early-return
-form (``if not tracing.ENABLED: return`` guards the statements after
-it). Span/event *builders* (``request_span``, ``lifecycle_event``, ...)
-are not flagged on their own: they only ever appear as arguments to an
-emit/append call, which carries the guard. The observability package
-itself (flag definitions, ``emit()``'s body, reset hooks) is exempt. A
-line may opt out with a ``# obs: unguarded`` comment when the guard
-lives somewhere static analysis cannot see (use sparingly). Run
-directly or via tests/test_metric_guards_check.py (tier-1).
+Prefer ``python -m tools.rtlint ray_tpu`` (all passes, cached) or
+``python -m tools.rtlint --pass metric-guards`` for new workflows.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import Iterable, List, Optional, Set
 
-# Observability modules whose ENABLED flag is a recognised guard.
-MODULES = {"core_metrics", "tracing"}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# Instrument recording methods (utils/metrics.py primitives).
-RECORD_METHODS = {"inc", "set", "observe"}
-
-OPT_OUT_MARK = "# obs: unguarded"
-
-# The observability package defines the flags and the emit sink — its
-# internals are the mechanism, not stamp sites.
-SKIP_PARTS = {"observability"}
-
-
-def _guards_in(test: ast.AST) -> Set[str]:
-    """Observability modules whose ENABLED attribute the test mentions."""
-    out: Set[str] = set()
-    for sub in ast.walk(test):
-        if (
-            isinstance(sub, ast.Attribute)
-            and sub.attr == "ENABLED"
-            and isinstance(sub.value, ast.Name)
-            and sub.value.id in MODULES
-        ):
-            out.add(sub.value.id)
-    return out
-
-
-def _terminates(stmts: List[ast.stmt]) -> bool:
-    return bool(stmts) and isinstance(
-        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
-    )
-
-
-def _required_guard(call: ast.Call) -> Optional[str]:
-    """Guard module a call needs, or None if the call isn't a stamp."""
-    func = call.func
-    if not isinstance(func, ast.Attribute):
-        return None
-    if (
-        func.attr == "emit"
-        and isinstance(func.value, ast.Name)
-        and func.value.id == "tracing"
-    ):
-        return "tracing"
-    if func.attr == "_append_task_event":
-        return "tracing"
-    if func.attr in RECORD_METHODS:
-        base = func.value
-        if (
-            isinstance(base, ast.Attribute)
-            and isinstance(base.value, ast.Name)
-            and base.value.id == "core_metrics"
-        ):
-            return "core_metrics"
-    return None
-
-
-def check_source(src: str, filename: str = "<src>") -> List[str]:
-    """Return a list of violation strings (empty = clean)."""
-    tree = ast.parse(src, filename=filename)
-    lines = src.splitlines()
-    violations: List[str] = []
-
-    def opted_out(lineno: int) -> bool:
-        return (
-            0 < lineno <= len(lines) and OPT_OUT_MARK in lines[lineno - 1]
-        )
-
-    def check_expr(node: ast.AST, guards: Set[str]) -> None:
-        # expressions contain no statements, so a plain walk is safe
-        for sub in ast.walk(node):
-            if not isinstance(sub, ast.Call):
-                continue
-            need = _required_guard(sub)
-            if need and need not in guards and not opted_out(sub.lineno):
-                violations.append(
-                    f"{filename}:{sub.lineno}: {ast.unparse(sub.func)}() "
-                    f"outside an `if {need}.ENABLED:` guard"
-                )
-
-    def expr_children(st: ast.stmt) -> Iterable[ast.AST]:
-        """Direct expression children of a statement (child statement
-        lists are visited separately, with their own guard context)."""
-        for _field, value in ast.iter_fields(st):
-            vals = value if isinstance(value, list) else [value]
-            for v in vals:
-                if isinstance(v, ast.AST) and not isinstance(
-                    v, (ast.stmt, ast.excepthandler)
-                ):
-                    yield v
-
-    def stmt_lists(st: ast.stmt) -> Iterable[List[ast.stmt]]:
-        for field in ("body", "orelse", "finalbody"):
-            v = getattr(st, field, None)
-            if v and isinstance(v[0], ast.stmt):
-                yield v
-        for h in getattr(st, "handlers", None) or ():
-            if h.body:
-                yield h.body
-
-    def visit(stmts: List[ast.stmt], guards: Set[str]) -> None:
-        acquired: Set[str] = set()
-        for st in stmts:
-            cur = guards | acquired
-            if isinstance(st, ast.If):
-                check_expr(st.test, cur)
-                test_guards = _guards_in(st.test)
-                if isinstance(st.test, ast.UnaryOp) and isinstance(
-                    st.test.op, ast.Not
-                ):
-                    # `if not mod.ENABLED: return` — the else branch and
-                    # (when the body terminates) every FOLLOWING sibling
-                    # statement run only with the flag on
-                    visit(st.body, cur)
-                    visit(st.orelse, cur | test_guards)
-                    if test_guards and _terminates(st.body):
-                        acquired |= test_guards
-                else:
-                    visit(st.body, cur | test_guards)
-                    visit(st.orelse, cur)
-                continue
-            for child in expr_children(st):
-                check_expr(child, cur)
-            for body in stmt_lists(st):
-                visit(body, cur)
-        # `acquired` is per-statement-list: sibling scope only
-
-    visit(tree.body, set())
-    return violations
-
-
-def check_file(path: str) -> List[str]:
-    with open(path) as f:
-        return check_source(f.read(), filename=path)
-
-
-def iter_default_files(root: str) -> Iterable[str]:
-    """Every .py file under ray_tpu/ except the observability package."""
-    pkg = os.path.join(root, "ray_tpu")
-    for dirpath, dirnames, filenames in os.walk(pkg):
-        dirnames[:] = [
-            d for d in sorted(dirnames)
-            if d not in SKIP_PARTS and not d.startswith("__pycache__")
-        ]
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
-def main(argv: List[str]) -> int:
-    if len(argv) > 1:
-        paths: List[str] = []
-        for arg in argv[1:]:
-            if os.path.isdir(arg):
-                paths.extend(iter_default_files(os.path.dirname(
-                    os.path.abspath(arg)
-                )))
-            else:
-                paths.append(arg)
-    else:
-        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        paths = list(iter_default_files(root))
-    violations: List[str] = []
-    for path in paths:
-        violations.extend(check_file(path))
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"{len(violations)} unguarded observability stamp(s)")
-        return 1
-    print(f"{len(paths)} file(s): all observability stamps guarded")
-    return 0
-
+from tools.rtlint.passes.metric_guards import (  # noqa: E402,F401
+    MODULES,
+    OPT_OUT_MARK,
+    PASS,
+    RECORD_METHODS,
+    SKIP_PARTS,
+    check_file,
+    check_source,
+    iter_default_files,
+    main,
+)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv))

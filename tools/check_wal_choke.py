@@ -1,243 +1,34 @@
 #!/usr/bin/env python
-"""Static check: control-store state mutations must flow through the WAL
-choke point.
+"""Shim: the WAL-choke checker now lives in the rtlint framework as the
+``wal-choke`` pass (tools/rtlint/passes/wal_choke.py).  This module
+keeps the historical entry points — ``check_source`` / ``check_file`` /
+``main`` and the rule constants — so existing tests, scripts, and
+muscle memory (``python tools/check_wal_choke.py``) keep working.
 
-Durability of the HA control plane (ray_tpu/core/ha/) rests on ONE
-invariant: every mutation of the control store's state tables happens
-inside a ``_mut_*`` state-machine function, reached only via
-``ControlStore._apply`` — which appends the op to the write-ahead log.
-A mutation anywhere else silently diverges recovery from live state.
-
-This checker walks ``control_store.py``'s AST and flags:
-
-1. direct mutations of a state table (``self._kv[...] = ...``,
-   ``self._actors.pop(...)``, ``self._next_job += 1`` ...) outside the
-   allowlisted functions;
-2. mutations through an ALIAS of a table or of a record read from one
-   (``node = self._nodes.get(...); node["alive"] = False``), with
-   alias propagation to a fixpoint inside each function (including
-   ``for pg in self._pgs.values():`` loop targets);
-3. direct calls of ``self._mut_*`` outside ``_apply`` and the restore
-   path (they would bypass the WAL append).
-
-Reads are always fine. A line may opt out with a ``# wal: copy``
-comment when it mutates a COPY that static analysis cannot prove is one
-(use sparingly; the reviewer owns that proof). Run it directly or via
-tests/test_wal_choke_check.py (tier-1).
+Prefer ``python -m tools.rtlint ray_tpu`` (all passes, cached) or
+``python -m tools.rtlint --pass wal-choke`` for new workflows.
 """
 
 from __future__ import annotations
 
-import ast
+import os
 import sys
-from typing import Dict, List, Set
 
-TABLES = {
-    "_kv", "_nodes", "_actors", "_named_actors", "_pgs", "_jobs",
-    "_next_job",
-}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# Functions allowed to touch tables directly: the mutation functions
-# themselves, construction, and the snapshot-load path (which replaces
-# whole tables before replay).
-ALLOWED_DIRECT = {"__init__", "_load_tables"}
-
-# Functions allowed to call self._mut_* directly: the choke point and the
-# WAL replay path.
-ALLOWED_MUT_CALLERS = {"_apply", "_restore"}
-
-MUTATING_METHODS = {
-    "pop", "popitem", "setdefault", "update", "clear", "append", "extend",
-    "insert", "remove", "add", "discard", "__setitem__",
-}
-
-OPT_OUT_MARK = "# wal: copy"
-
-
-def _is_self_table(node: ast.AST) -> bool:
-    """self.<table> attribute access."""
-    return (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "self"
-        and node.attr in TABLES
-    )
-
-
-def _names_in(node: ast.AST) -> Set[str]:
-    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
-
-
-def _mentions_table_or_alias(node: ast.AST, aliases: Set[str]) -> bool:
-    for sub in ast.walk(node):
-        if _is_self_table(sub):
-            return True
-        if isinstance(sub, ast.Name) and sub.id in aliases:
-            return True
-    return False
-
-
-def _target_names(target: ast.AST) -> Set[str]:
-    """Names bound by an assignment/for target (handles tuple unpacking)."""
-    out: Set[str] = set()
-    for sub in ast.walk(target):
-        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
-            out.add(sub.id)
-    return out
-
-
-def _collect_aliases(fn: ast.AST) -> Set[str]:
-    """Names that (possibly transitively) refer to table records within
-    one function, computed to a fixpoint."""
-    aliases: Set[str] = set()
-    changed = True
-    while changed:
-        changed = False
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign):
-                if _mentions_table_or_alias(node.value, aliases):
-                    for t in node.targets:
-                        new = _target_names(t) - aliases
-                        if new:
-                            aliases |= new
-                            changed = True
-            elif isinstance(node, (ast.For, ast.AsyncFor)):
-                if _mentions_table_or_alias(node.iter, aliases):
-                    new = _target_names(node.target) - aliases
-                    if new:
-                        aliases |= new
-                        changed = True
-            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
-                                   ast.GeneratorExp)):
-                for gen in node.generators:
-                    if _mentions_table_or_alias(gen.iter, aliases):
-                        new = _target_names(gen.target) - aliases
-                        if new:
-                            aliases |= new
-                            changed = True
-    return aliases
-
-
-def _base_of(node: ast.AST) -> ast.AST:
-    """Peel subscripts/attributes to the base expression being mutated:
-    self._kv[ns][k] -> self._kv; node["x"] -> node."""
-    while isinstance(node, (ast.Subscript, ast.Attribute)):
-        if _is_self_table(node):
-            return node
-        node = node.value
-    return node
-
-
-def _is_mutation_target(node: ast.AST, aliases: Set[str]) -> bool:
-    base = _base_of(node)
-    if _is_self_table(base):
-        return True
-    return isinstance(base, ast.Name) and base.id in aliases
-
-
-def check_source(src: str, filename: str = "control_store.py") -> List[str]:
-    """Return a list of violation strings (empty = clean)."""
-    tree = ast.parse(src, filename=filename)
-    lines = src.splitlines()
-    violations: List[str] = []
-
-    def opted_out(lineno: int) -> bool:
-        return (
-            0 < lineno <= len(lines) and OPT_OUT_MARK in lines[lineno - 1]
-        )
-
-    def flag(fn_name: str, node: ast.AST, what: str) -> None:
-        if opted_out(node.lineno):
-            return
-        violations.append(
-            f"{filename}:{node.lineno}: in {fn_name}(): {what}"
-        )
-
-    # map every function (methods included) to its own subtree
-    functions: Dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            functions.setdefault(node.name, node)
-
-    for fn_name, fn in functions.items():
-        in_mut = fn_name.startswith("_mut_") or fn_name in ALLOWED_DIRECT
-        aliases = _collect_aliases(fn)
-        for node in ast.walk(fn):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
-                continue  # nested defs get their own pass
-            # direct _mut_ calls outside the choke point
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr.startswith("_mut_")
-                and fn_name not in ALLOWED_MUT_CALLERS
-                and not fn_name.startswith("_mut_")
-            ):
-                flag(fn_name, node,
-                     f"direct call of {node.func.attr}() bypasses the WAL "
-                     f"choke point (use self._apply)")
-            if in_mut:
-                continue
-            # assignments / deletions into tables or aliases of them
-            if isinstance(node, (ast.Assign, ast.AugAssign)):
-                targets = (
-                    node.targets if isinstance(node, ast.Assign)
-                    else [node.target]
-                )
-                for t in targets:
-                    # rebinding a bare local name is not a mutation
-                    if isinstance(t, ast.Name):
-                        continue
-                    if isinstance(t, ast.Tuple):
-                        continue
-                    if _is_mutation_target(t, aliases):
-                        flag(fn_name, node,
-                             "state-table mutation outside the WAL choke "
-                             "point")
-            elif isinstance(node, ast.Delete):
-                for t in node.targets:
-                    if not isinstance(t, ast.Name) and _is_mutation_target(
-                        t, aliases
-                    ):
-                        flag(fn_name, node,
-                             "state-table deletion outside the WAL choke "
-                             "point")
-            elif (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in MUTATING_METHODS
-                and _is_mutation_target(node.func.value, aliases)
-            ):
-                flag(fn_name, node,
-                     f".{node.func.attr}() on a state table (or an alias "
-                     f"of one) outside the WAL choke point")
-    return violations
-
-
-def check_file(path: str) -> List[str]:
-    with open(path) as f:
-        return check_source(f.read(), filename=path)
-
-
-def main(argv: List[str]) -> int:
-    import os
-
-    if len(argv) > 1:
-        path = argv[1]
-    else:
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "ray_tpu", "core", "control_store.py",
-        )
-    violations = check_file(path)
-    for v in violations:
-        print(v)
-    if violations:
-        print(f"{len(violations)} WAL-choke violation(s)")
-        return 1
-    print(f"{path}: WAL choke point intact")
-    return 0
-
+from tools.rtlint.passes.wal_choke import (  # noqa: E402,F401
+    ALLOWED_DIRECT,
+    ALLOWED_MUT_CALLERS,
+    MUTATING_METHODS,
+    OPT_OUT_MARK,
+    PASS,
+    TABLES,
+    check_file,
+    check_source,
+    main,
+)
 
 if __name__ == "__main__":
     sys.exit(main(sys.argv))

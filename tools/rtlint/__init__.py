@@ -1,0 +1,37 @@
+"""rtlint — the repo's unified static-analysis framework.
+
+One engine (parse each file once, per-file AST context, mtime-keyed
+result cache, ``file:line:pass-id`` findings, ``# rtlint:
+ignore[pass-id] <reason>`` suppressions that REQUIRE a written reason),
+plus a registry of passes enforcing the invariants this codebase keeps
+re-breaking at review time:
+
+========================  ==============================================
+pass id                   invariant
+========================  ==============================================
+wal-choke                 control-store mutations flow through _apply
+inband-payloads           hot-path RPC/channel sends carry no raw
+                          packed payloads in-band
+metric-guards             every observability stamp is kill-switch
+                          guarded
+blocking-async            no blocking calls on the event loop (async
+                          bodies + the serve fast-handler path)
+dispatcher-block          rpc_* handlers never hold a dispatcher thread
+                          for an unbounded / caller-supplied deadline
+resource-leak             leak-prone resources (threads, tempfiles, shm
+                          channels, sockets) reach a cleanup or escape
+                          to an owner
+config-hygiene            every RT_* env read goes through utils/config;
+                          every registered flag is documented in README
+========================  ==============================================
+
+Run: ``python -m tools.rtlint ray_tpu`` (tier-1 via tests/test_rtlint.py).
+"""
+
+from tools.rtlint.engine import (  # noqa: F401
+    Finding,
+    FileContext,
+    check_source,
+    run_paths,
+)
+from tools.rtlint.passes import REGISTRY, get_pass  # noqa: F401

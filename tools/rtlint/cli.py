@@ -1,0 +1,123 @@
+"""rtlint CLI: ``python -m tools.rtlint [paths] [--json] [--changed]``.
+
+Exit status: 0 clean (suppressed findings are fine), 1 unsuppressed
+findings, 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+# Allow `python tools/rtlint/cli.py` too, not just `python -m tools.rtlint`.
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from tools.rtlint.engine import changed_files, repo_root, run_paths  # noqa: E402
+from tools.rtlint.passes import REGISTRY, get_pass  # noqa: E402
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.rtlint",
+        description="ray_tpu static-analysis suite",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: ray_tpu)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output",
+    )
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed per git (diff vs HEAD + untracked)",
+    )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write .rtlint_cache.json",
+    )
+    ap.add_argument(
+        "--pass", dest="only_pass", metavar="ID",
+        help="run a single pass by id",
+    )
+    ap.add_argument(
+        "--list-passes", action="store_true",
+        help="list registered passes and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in REGISTRY:
+            print(f"{p.id:18s} {p.doc}")
+        return 0
+
+    root = repo_root()
+    if args.changed:
+        targets = changed_files(root)
+        if args.paths:
+            prefixes = tuple(os.path.normpath(p) for p in args.paths)
+            targets = [
+                t for t in targets
+                if os.path.normpath(t).startswith(prefixes)
+            ]
+        if not targets:
+            if args.as_json:
+                print(json.dumps({
+                    "findings": [], "suppressed": [],
+                    "files_checked": 0, "cache_hits": 0,
+                }))
+            else:
+                print("rtlint: no changed python files")
+            return 0
+    else:
+        targets = args.paths or ["ray_tpu"]
+
+    passes = None
+    if args.only_pass:
+        try:
+            passes = [get_pass(args.only_pass)]
+        except KeyError as e:
+            print(e.args[0], file=sys.stderr)
+            return 2
+
+    result = run_paths(
+        targets,
+        root=root,
+        use_cache=not args.no_cache,
+        passes=passes,
+        # --changed runs are partial: the README cross-check would
+        # re-report project findings unrelated to the diff
+        project_checks=not args.changed,
+    )
+    findings = result["findings"]
+    suppressed = result["suppressed"]
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "files_checked": result["files_checked"],
+            "cache_hits": result["cache_hits"],
+        }, indent=2))
+        return 1 if findings else 0
+
+    for f in findings:
+        print(f.format())
+    n = len(findings)
+    print(
+        f"rtlint: {result['files_checked']} file(s), "
+        f"{result['cache_hits']} cached, {n} finding(s), "
+        f"{len(suppressed)} suppressed"
+    )
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,500 @@
+"""The rtlint engine: file discovery, parse-once AST contexts, suppression
+handling, the mtime-keyed result cache, and the runner.
+
+Design notes
+------------
+
+* Each file is read and ``ast.parse``d ONCE per run; every selected pass
+  receives the same :class:`FileContext` (tree, source lines, resolved
+  module-level constants).  Passes return ``(line, message)`` tuples and
+  never do their own I/O.
+* A finding renders as ``file:line:pass-id: message``.
+* Suppressions are same-line comments::
+
+      something_flagged()  # rtlint: ignore[pass-id] short justification
+
+  The justification is REQUIRED — a bare ``# rtlint: ignore[pass-id]``
+  is itself reported (pass id ``suppression``).  Several ids may be
+  given, comma-separated.  Legacy opt-out marks (``# wal: copy``,
+  ``# inband: ok``, ``# obs: unguarded``) keep working inside their
+  ported passes.
+* The cache (``.rtlint_cache.json`` at the repo root, gitignored) maps
+  ``relpath -> (mtime, size, findings)`` and is keyed on a fingerprint
+  of rtlint's own sources, so editing any pass invalidates everything.
+  Only per-file findings are cached; project-level checks (e.g. the
+  config-hygiene flag/README cross-check) run every time.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*rtlint:\s*ignore\[([A-Za-z0-9_,\s-]+)\]\s*(.*?)\s*$"
+)
+
+CACHE_BASENAME = ".rtlint_cache.json"
+CACHE_VERSION = 1
+
+# pass id used for meta-findings about malformed suppressions
+SUPPRESSION_PASS_ID = "suppression"
+# pass id used when a target file does not parse
+PARSE_PASS_ID = "parse"
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+@dataclass
+class Finding:
+    file: str
+    line: int
+    pass_id: str
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}:{self.pass_id}: {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "pass": self.pass_id,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "Finding":
+        return cls(
+            file=str(d["file"]),
+            line=int(d["line"]),  # type: ignore[arg-type]
+            pass_id=str(d["pass"]),
+            message=str(d["message"]),
+            suppressed=bool(d.get("suppressed", False)),
+            reason=str(d.get("reason", "")),
+        )
+
+
+@dataclass
+class Suppression:
+    line: int
+    pass_ids: Tuple[str, ...]
+    reason: str
+
+
+def parse_suppressions(lines: Sequence[str]) -> Dict[int, Suppression]:
+    out: Dict[int, Suppression] = {}
+    for i, text in enumerate(lines, start=1):
+        if "rtlint" not in text:
+            continue
+        m = SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = tuple(
+            p.strip() for p in m.group(1).split(",") if p.strip()
+        )
+        out[i] = Suppression(line=i, pass_ids=ids, reason=m.group(2))
+    return out
+
+
+class FileContext:
+    """Parsed-once view of a single source file, shared by all passes."""
+
+    def __init__(self, relpath: str, src: str):
+        self.relpath = relpath
+        self.src = src
+        self.lines: List[str] = src.splitlines()
+        self.tree: ast.Module = ast.parse(src, filename=relpath)
+        self._constants: Optional[Dict[str, object]] = None
+        self._functions: Optional[
+            List[Tuple[str, ast.AST]]
+        ] = None
+
+    @property
+    def module_constants(self) -> Dict[str, object]:
+        """Module-level ``NAME = <literal>`` bindings (str/int/float)."""
+        if self._constants is None:
+            consts: Dict[str, object] = {}
+            for node in self.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    try:
+                        consts[node.targets[0].id] = ast.literal_eval(
+                            node.value
+                        )
+                    except (ValueError, SyntaxError):
+                        pass
+            self._constants = consts
+        return self._constants
+
+    @property
+    def functions(self) -> List[Tuple[str, ast.AST]]:
+        """All (async) function defs in the file, methods included."""
+        if self._functions is None:
+            fns: List[Tuple[str, ast.AST]] = []
+            for node in ast.walk(self.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    fns.append((node.name, node))
+            self._functions = fns
+        return self._functions
+
+    def line_text(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def line_has_mark(self, lineno: int, mark: str) -> bool:
+        return mark in self.line_text(lineno)
+
+
+class LintPass:
+    """Base class for passes.  Subclasses set ``id``/``title``/``doc``,
+    implement ``select`` + ``run``; project-wide checks go in
+    ``project_check`` (uncached, runs once per engine run)."""
+
+    id: str = ""
+    title: str = ""
+    doc: str = ""
+
+    def select(self, relpath: str) -> bool:
+        raise NotImplementedError
+
+    def run(self, ctx: FileContext) -> List[Tuple[int, str]]:
+        raise NotImplementedError
+
+    def project_check(self, root: str) -> List[Finding]:
+        return []
+
+
+def apply_suppressions(
+    findings: List[Finding],
+    suppressions: Dict[int, Suppression],
+    relpath: str,
+) -> List[Finding]:
+    """Mark findings suppressed when a same-line ``# rtlint: ignore[...]``
+    names their pass; emit meta-findings for ignores without a reason."""
+    out: List[Finding] = []
+    used: set = set()
+    for f in findings:
+        sup = suppressions.get(f.line)
+        if sup is not None and f.pass_id in sup.pass_ids:
+            used.add(f.line)
+            if sup.reason:
+                f.suppressed = True
+                f.reason = sup.reason
+            else:
+                out.append(
+                    Finding(
+                        file=relpath,
+                        line=f.line,
+                        pass_id=SUPPRESSION_PASS_ID,
+                        message=(
+                            f"suppression of [{f.pass_id}] has no "
+                            f"reason — write one: "
+                            f"# rtlint: ignore[{f.pass_id}] <why>"
+                        ),
+                    )
+                )
+        out.append(f)
+    # A reasonless ignore that matched nothing still deserves a nudge:
+    # it is either stale or about to hide a future finding silently.
+    for line, sup in suppressions.items():
+        if line in used or sup.reason:
+            continue
+        out.append(
+            Finding(
+                file=relpath,
+                line=line,
+                pass_id=SUPPRESSION_PASS_ID,
+                message=(
+                    "rtlint suppression has no reason — write one: "
+                    f"# rtlint: ignore[{','.join(sup.pass_ids)}] <why>"
+                ),
+            )
+        )
+    return out
+
+
+def lint_source(
+    src: str,
+    relpath: str,
+    passes: Sequence[LintPass],
+) -> List[Finding]:
+    """Run ``passes`` over one in-memory source.  Engine-level entry used
+    both by the runner and by tests exercising passes through the engine."""
+    selected = [p for p in passes if p.select(relpath)]
+    suppressions = parse_suppressions(src.splitlines())
+    if not selected and not suppressions:
+        return []
+    try:
+        ctx = FileContext(relpath, src)
+    except SyntaxError as e:
+        return [
+            Finding(
+                file=relpath,
+                line=e.lineno or 1,
+                pass_id=PARSE_PASS_ID,
+                message=f"does not parse: {e.msg}",
+            )
+        ]
+    findings: List[Finding] = []
+    for p in selected:
+        for line, message in p.run(ctx):
+            findings.append(
+                Finding(
+                    file=relpath, line=line, pass_id=p.id, message=message
+                )
+            )
+    findings = apply_suppressions(findings, suppressions, relpath)
+    findings.sort(key=lambda f: (f.line, f.pass_id))
+    return findings
+
+
+def check_source(
+    src: str,
+    filename: str = "<source>",
+    pass_ids: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Convenience wrapper: lint one source string with the registered
+    passes (all of them, or the named subset), ignoring ``select`` when
+    an explicit subset is given so fixtures need no special paths."""
+    from tools.rtlint.passes import REGISTRY, get_pass
+
+    if pass_ids is None:
+        passes: List[LintPass] = [p for p in REGISTRY]
+        return lint_source(src, filename, passes)
+
+    selected = [get_pass(pid) for pid in pass_ids]
+
+    class _Forced(LintPass):
+        def __init__(self, inner: LintPass):
+            self.inner = inner
+            self.id = inner.id
+
+        def select(self, relpath: str) -> bool:
+            return True
+
+        def run(self, ctx: FileContext) -> List[Tuple[int, str]]:
+            return self.inner.run(ctx)
+
+    return lint_source(src, filename, [_Forced(p) for p in selected])
+
+
+# ---------------------------------------------------------------------------
+# cache
+
+
+def _engine_fingerprint() -> str:
+    """Hash of rtlint's own sources (path, mtime, size): editing any pass
+    or the engine invalidates every cached result."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    entries = []
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            st = os.stat(path)
+            entries.append(
+                (os.path.relpath(path, pkg), st.st_mtime, st.st_size)
+            )
+    entries.sort()
+    h = hashlib.sha256(repr(entries).encode())
+    h.update(str(CACHE_VERSION).encode())
+    return h.hexdigest()
+
+
+class ResultCache:
+    def __init__(self, path: str, fingerprint: str):
+        self.path = path
+        self.fingerprint = fingerprint
+        self._files: Dict[str, Dict[str, object]] = {}
+        self._dirty = False
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if data.get("fingerprint") == fingerprint:
+                self._files = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    def get(
+        self, relpath: str, mtime: float, size: int
+    ) -> Optional[List[Finding]]:
+        ent = self._files.get(relpath)
+        if not ent:
+            return None
+        if ent.get("mtime") != mtime or ent.get("size") != size:
+            return None
+        return [Finding.from_dict(d) for d in ent.get("findings", [])]
+
+    def put(
+        self,
+        relpath: str,
+        mtime: float,
+        size: int,
+        findings: List[Finding],
+    ) -> None:
+        self._files[relpath] = {
+            "mtime": mtime,
+            "size": size,
+            "findings": [f.to_dict() for f in findings],
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "fingerprint": self.fingerprint,
+                        "files": self._files,
+                    },
+                    f,
+                )
+            os.replace(tmp, self.path)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# discovery + runner
+
+
+def _iter_py_files(root: str, targets: Sequence[str]) -> List[str]:
+    """Expand targets (files or directories, relative to root) into a
+    sorted list of .py relpaths."""
+    out: List[str] = []
+    seen: set = set()
+    for target in targets:
+        path = target if os.path.isabs(target) else os.path.join(root, target)
+        if os.path.isfile(path):
+            rel = os.path.relpath(path, root)
+            if rel not in seen:
+                seen.add(rel)
+                out.append(rel)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", "build")
+            ]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                if rel not in seen:
+                    seen.add(rel)
+                    out.append(rel)
+    out.sort()
+    return out
+
+
+def changed_files(root: str) -> List[str]:
+    """Python files touched per git (diff vs HEAD + untracked)."""
+    rels: List[str] = []
+    for args in (
+        ["git", "-C", root, "diff", "--name-only", "HEAD"],
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                args, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if res.returncode != 0:
+            continue
+        rels.extend(
+            line.strip()
+            for line in res.stdout.splitlines()
+            if line.strip().endswith(".py")
+        )
+    return sorted(set(r for r in rels if os.path.exists(os.path.join(root, r))))
+
+
+def run_paths(
+    targets: Sequence[str],
+    root: Optional[str] = None,
+    use_cache: bool = True,
+    passes: Optional[Sequence[LintPass]] = None,
+    cache_path: Optional[str] = None,
+    project_checks: bool = True,
+) -> Dict[str, object]:
+    """Lint ``targets`` (files/dirs relative to ``root``).  Returns a dict
+    with ``findings`` (unsuppressed), ``suppressed``, ``files_checked``,
+    ``cache_hits``."""
+    from tools.rtlint.passes import REGISTRY
+
+    root = root or repo_root()
+    active: Sequence[LintPass] = passes if passes is not None else REGISTRY
+    relpaths = _iter_py_files(root, targets)
+
+    cache: Optional[ResultCache] = None
+    if use_cache:
+        cache = ResultCache(
+            cache_path or os.path.join(root, CACHE_BASENAME),
+            _engine_fingerprint(),
+        )
+
+    all_findings: List[Finding] = []
+    cache_hits = 0
+    for rel in relpaths:
+        path = os.path.join(root, rel)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        if cache is not None:
+            hit = cache.get(rel, st.st_mtime, st.st_size)
+            if hit is not None:
+                cache_hits += 1
+                all_findings.extend(hit)
+                continue
+        try:
+            with open(path) as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        findings = lint_source(src, rel, active)
+        if cache is not None:
+            cache.put(rel, st.st_mtime, st.st_size, findings)
+        all_findings.extend(findings)
+
+    if project_checks:
+        for p in active:
+            all_findings.extend(p.project_check(root))
+
+    if cache is not None:
+        cache.save()
+
+    all_findings.sort(key=lambda f: (f.file, f.line, f.pass_id))
+    return {
+        "findings": [f for f in all_findings if not f.suppressed],
+        "suppressed": [f for f in all_findings if f.suppressed],
+        "files_checked": len(relpaths),
+        "cache_hits": cache_hits,
+    }

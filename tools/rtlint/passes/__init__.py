@@ -1,0 +1,41 @@
+"""The rtlint pass registry.
+
+Adding a pass: create ``tools/rtlint/passes/<name>.py`` with a
+``LintPass`` subclass (set ``id``/``title``/``doc``, implement
+``select`` + ``run``, optionally ``project_check``), expose a module
+level ``PASS`` instance, and append the module here.  Fixture tests go
+in tests/test_rtlint_passes.py (true positive, suppressed-with-reason,
+clean negative); the README pass table is checked by hand.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.rtlint.engine import LintPass
+from tools.rtlint.passes import (
+    blocking_async,
+    config_hygiene,
+    dispatcher_block,
+    inband_payloads,
+    metric_guards,
+    resource_leak,
+    wal_choke,
+)
+
+REGISTRY: List[LintPass] = [
+    wal_choke.PASS,
+    inband_payloads.PASS,
+    metric_guards.PASS,
+    blocking_async.PASS,
+    dispatcher_block.PASS,
+    resource_leak.PASS,
+    config_hygiene.PASS,
+]
+
+
+def get_pass(pass_id: str) -> LintPass:
+    for p in REGISTRY:
+        if p.id == pass_id:
+            return p
+    raise KeyError(f"unknown rtlint pass: {pass_id!r}")

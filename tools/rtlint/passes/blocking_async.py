@@ -1,0 +1,192 @@
+"""blocking-async pass: no blocking calls on the event loop.
+
+The serve tier runs one asyncio loop per proxy (serve/http_server.py);
+``async def`` bodies and the registered ``fast_handler`` execute ON that
+loop.  One ``time.sleep`` / synchronous ``RpcClient.call`` /
+``subprocess`` invocation there stalls every in-flight request on the
+proxy — nothing fails, p99 just explodes.  Blocking handlers belong in
+the pool tier (``FallbackToPool``) or behind ``call_async``.
+
+Checked contexts:
+
+1. every ``async def`` body (nested sync ``def``s excluded — they run
+   wherever they are called, e.g. shipped to the pool);
+2. the serve fast-handler path: any function passed as a
+   ``fast_handler=`` keyword argument in the same file (``self._x`` /
+   bare-name references are resolved to same-file defs).
+
+Flagged calls:
+
+* ``time.sleep(...)`` (and bare ``sleep`` when imported from time);
+* ``subprocess.<anything>`` (and names imported from subprocess);
+* blocking socket methods: ``.accept/.recv/.recv_into/.recvfrom/
+  .sendall/.connect``;
+* synchronous RPC: ``.call(...)`` — use ``.call_async`` and await the
+  promise (``call_soon*``/``call_async``/``call_oneway`` are fine);
+* future/thread joins: ``.result()``, zero-arg ``.join()``, blocking
+  ``.acquire()``, and non-zero-timeout ``.wait()``
+  (``loop.run_in_executor`` results must be awaited instead).
+
+``await``-ed expressions are never flagged (``asyncio.sleep`` etc. have
+different names anyway).  Suppress with
+``# rtlint: ignore[blocking-async] <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from tools.rtlint.engine import FileContext, LintPass
+
+BLOCKING_SOCKET_METHODS = {
+    "accept", "recv", "recv_into", "recvfrom", "sendall", "connect",
+}
+SYNC_WAIT_METHODS = {"result", "join", "acquire", "wait"}
+SYNC_RPC_METHODS = {"call"}
+
+
+def _fast_handler_names(tree: ast.Module) -> Set[str]:
+    """Function names referenced by a ``fast_handler=`` keyword argument
+    anywhere in the file (``self._try_fast`` -> ``_try_fast``)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "fast_handler":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Attribute):
+                names.add(v.attr)
+            elif isinstance(v, ast.Name):
+                names.add(v.id)
+    return names
+
+
+def _imported_names(tree: ast.Module, module: str) -> Set[str]:
+    """Local names bound by ``from <module> import x [as y]``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            for alias in node.names:
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _own_statements(fn: ast.AST):
+    """Walk a function's body excluding nested function/class defs (they
+    run in their own context — a nested sync def may well be shipped to
+    the pool)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _awaited_calls(fn: ast.AST) -> Set[ast.AST]:
+    """Call nodes anywhere under an ``await`` expression.  ``await
+    cv.wait()`` and ``await asyncio.wait_for(ev.wait(), t)`` are async
+    waits, not loop stalls — the whole awaited subtree is exempt."""
+    out: Set[ast.AST] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Await):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Call):
+                    out.add(sub)
+    return out
+
+
+def _classify_call(
+    call: ast.Call,
+    time_sleep_aliases: Set[str],
+    subprocess_names: Set[str],
+) -> Optional[str]:
+    """Why this call blocks, or None."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "time" and func.attr == "sleep":
+                return "time.sleep() blocks the event loop"
+            if base.id == "subprocess":
+                return f"subprocess.{func.attr}() blocks the event loop"
+        if func.attr in BLOCKING_SOCKET_METHODS:
+            return (
+                f"blocking socket op .{func.attr}() on the event loop "
+                f"— use asyncio streams"
+            )
+        if func.attr in SYNC_RPC_METHODS:
+            return (
+                ".call() is a synchronous RPC — use .call_async() and "
+                "await the promise"
+            )
+        if func.attr in SYNC_WAIT_METHODS:
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            if func.attr == "wait" and any(
+                isinstance(a, ast.Constant)
+                and isinstance(a.value, (int, float))
+                and a.value == 0
+                for a in args
+            ):
+                return None  # wait(0) polls, it does not block
+            if func.attr == "join" and (call.args or call.keywords):
+                return None  # ", ".join(parts) / t.join(timeout) — skip
+            if func.attr == "acquire" and any(
+                isinstance(a, ast.Constant) and a.value is False
+                for a in args
+            ):
+                return None  # non-blocking acquire
+            return (
+                f".{func.attr}() waits synchronously on the event loop "
+                f"— await the async form or ship to the pool"
+            )
+    elif isinstance(func, ast.Name):
+        if func.id in time_sleep_aliases:
+            return "time.sleep() blocks the event loop"
+        if func.id in subprocess_names:
+            return f"subprocess {func.id}() blocks the event loop"
+    return None
+
+
+class BlockingAsyncPass(LintPass):
+    id = "blocking-async"
+    title = "blocking call in async context"
+    doc = ("no time.sleep / sync .call() / subprocess / blocking socket "
+           "ops in async def bodies or the serve fast-handler path")
+
+    def select(self, relpath: str) -> bool:
+        return relpath.endswith(".py")
+
+    def run(self, ctx: FileContext) -> List[Tuple[int, str]]:
+        fast_names = _fast_handler_names(ctx.tree)
+        time_sleep = {
+            n for n in _imported_names(ctx.tree, "time") if n == "sleep"
+        }
+        subprocess_names = _imported_names(ctx.tree, "subprocess")
+        out: List[Tuple[int, str]] = []
+        for name, fn in ctx.functions:
+            is_async = isinstance(fn, ast.AsyncFunctionDef)
+            on_loop = is_async or name in fast_names
+            if not on_loop:
+                continue
+            where = (
+                f"async {name}()" if is_async
+                else f"{name}() [fast_handler: runs on the event loop]"
+            )
+            awaited = _awaited_calls(fn)
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.Call) or node in awaited:
+                    continue
+                why = _classify_call(node, time_sleep, subprocess_names)
+                if why:
+                    out.append((node.lineno, f"in {where}: {why}"))
+        return out
+
+
+PASS = BlockingAsyncPass()

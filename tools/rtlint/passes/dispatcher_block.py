@@ -1,0 +1,329 @@
+"""dispatcher-block pass: rpc handlers must not hold a dispatcher thread
+for an unbounded or caller-supplied deadline.
+
+The PR 7 recv bug class: a server-side ``rpc_*`` handler that waits out
+a caller-supplied ``wait_s`` strands one dispatcher thread per blocked
+caller for the full deadline (60 s kv_wait defaults; placement-group
+ready() used to pass wait_s=3600).  Under fan-in — a collective barrier,
+a restart storm — that's the whole dispatch pool gone while the data
+needed to unblock the callers sits in the queue behind them.  The
+contract: server-side waits are SLICED (``wait_s = min(wait_s,
+config.dispatch_wait_slice_s)``) and clients re-issue slices until their
+own deadline (see collective/collective.py ``_recv_either`` for the
+canonical client loop).
+
+Checked: ``rpc_*`` and ``_raw_*`` functions in ``control_store.py`` and
+``node_agent.py``.  Flags:
+
+1. unbounded primitive waits: zero-arg ``.wait()`` / ``.join()`` /
+   ``.get()`` (or an explicit ``timeout=None``);
+2. a wait loop run to a caller-supplied deadline: ``deadline =
+   time.monotonic() + wait_s`` (``wait_s`` a parameter, not capped)
+   followed by a ``while`` that references the deadline and sleeps or
+   waits inside;
+3. a condition/event wait whose timeout expression mentions an uncapped
+   parameter directly (``cv.wait(wait_s)``);
+4. the same one call deep: passing an uncapped parameter or deadline to
+   a same-file helper whose body runs such a wait loop on it.
+
+A parameter counts as capped once the function rebinds it through
+``min(...)`` (``wait_s = min(wait_s, <slice>)``) or the deadline
+expression itself is ``min``-bounded by a constant ≤ 5 s.  Periodic
+maintenance loops (``while not self._stopped.wait(period)``) reference
+no caller parameter and are not flagged.  Suppress with
+``# rtlint: ignore[dispatcher-block] <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.rtlint.engine import FileContext, LintPass
+
+CHECKED_BASENAMES = {"control_store.py", "node_agent.py"}
+HANDLER_PREFIXES = ("rpc_", "_raw_")
+# a min(..., c) bound at or below this many seconds counts as sliced
+SLICE_MAX_S = 5.0
+WAIT_METHODS = {"wait"}
+SLEEP_FNS = {"sleep"}
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _const_value(node: ast.AST, consts: Dict[str, object]):
+    """Numeric value of a constant / resolvable module constant, else
+    None."""
+    if isinstance(node, ast.Constant) and isinstance(
+        node.value, (int, float)
+    ):
+        return float(node.value)
+    if isinstance(node, ast.Name) and isinstance(
+        consts.get(node.id), (int, float)
+    ):
+        return float(consts[node.id])  # type: ignore[arg-type]
+    return None
+
+
+def _is_min_bounded(node: ast.AST, consts: Dict[str, object]) -> bool:
+    """``min(..., c)`` with any arm a constant ≤ SLICE_MAX_S."""
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "min"
+        ):
+            for a in sub.args:
+                v = _const_value(a, consts)
+                if v is not None and v <= SLICE_MAX_S:
+                    return True
+    return False
+
+
+def _handler_params(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    return {n for n in names if n not in ("self", "cls", "conn")}
+
+
+def _capped_params(fn: ast.AST, params: Set[str]) -> Set[str]:
+    """Parameters the function rebinds through min(...): the explicit
+    server-side slice pattern."""
+    capped: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = {
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        }
+        hit = targets & params
+        if not hit:
+            continue
+        for sub in ast.walk(node.value):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Name)
+                and sub.func.id == "min"
+            ):
+                capped |= hit
+                break
+    return capped
+
+
+def _deadline_names(
+    fn: ast.AST, uncapped: Set[str], consts: Dict[str, object]
+) -> Set[str]:
+    """Locals assigned from ``time.monotonic()/time.time() + <param>``
+    with the param uncapped and the sum not min-bounded."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        mentions_clock = any(
+            isinstance(s, ast.Attribute)
+            and s.attr in ("monotonic", "time")
+            for s in ast.walk(value)
+        )
+        if not mentions_clock:
+            continue
+        if not (_names_in(value) & uncapped):
+            continue
+        if _is_min_bounded(value, consts):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _is_wait_or_sleep(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in WAIT_METHODS:
+            return True
+        if f.attr in SLEEP_FNS and isinstance(f.value, ast.Name) and \
+                f.value.id == "time":
+            return True
+    if isinstance(f, ast.Name) and f.id in SLEEP_FNS:
+        return True
+    return False
+
+
+def _deadline_wait_loops(
+    fn: ast.AST, deadline_names: Set[str]
+) -> List[Tuple[int, str]]:
+    """While loops that reference a deadline name and wait/sleep inside:
+    (lineno, deadline_name) pairs."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.While):
+            continue
+        refs = _names_in(node) & deadline_names
+        if not refs:
+            continue
+        if any(_is_wait_or_sleep(sub) for sub in ast.walk(node)):
+            out.append((node.lineno, sorted(refs)[0]))
+    return out
+
+
+def _direct_param_waits(
+    fn: ast.AST, uncapped: Set[str], consts: Dict[str, object]
+) -> List[Tuple[int, str]]:
+    """``cv.wait(<expr mentioning an uncapped param>)`` sites."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in WAIT_METHODS
+            and node.args
+        ):
+            continue
+        expr = node.args[0]
+        refs = _names_in(expr) & uncapped
+        if refs and not _is_min_bounded(expr, consts):
+            out.append((node.lineno, sorted(refs)[0]))
+    return out
+
+
+def _unbounded_primitive_waits(fn: ast.AST) -> List[Tuple[int, str]]:
+    """Zero-arg .wait()/.join()/.get() or explicit timeout=None."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("wait", "join", "get")
+        ):
+            continue
+        timeout_none = any(
+            kw.arg in ("timeout", "timeout_s")
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is None
+            for kw in node.keywords
+        )
+        if (not node.args and not node.keywords) or timeout_none:
+            # zero-arg .get() is queue-like (dict.get always takes a key)
+            out.append((node.lineno, node.func.attr))
+    return out
+
+
+def _callee_param_for_arg(
+    call: ast.Call, callee: ast.AST, dirty: Set[str]
+) -> Optional[str]:
+    """Name of the callee parameter that receives an argument mentioning
+    a dirty name, accounting for the bound ``self`` when the call goes
+    through an attribute."""
+    args = callee.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    if params and params[0] in ("self", "cls") and isinstance(
+        call.func, ast.Attribute
+    ):
+        params = params[1:]
+    for i, a in enumerate(call.args):
+        if _names_in(a) & dirty and i < len(params):
+            return params[i]
+    kw_ok = {a.arg for a in args.args + args.kwonlyargs}
+    for kw in call.keywords:
+        if kw.arg and kw.arg in kw_ok and _names_in(kw.value) & dirty:
+            return kw.arg
+    return None
+
+
+class DispatcherBlockPass(LintPass):
+    id = "dispatcher-block"
+    title = "dispatcher thread held to a caller deadline"
+    doc = ("rpc_* handlers in control_store.py/node_agent.py must slice "
+           "server-side waits; never hold a dispatcher thread for a "
+           "caller-supplied deadline")
+
+    def select(self, relpath: str) -> bool:
+        return os.path.basename(relpath) in CHECKED_BASENAMES
+
+    def run(self, ctx: FileContext) -> List[Tuple[int, str]]:
+        consts = ctx.module_constants
+        by_name: Dict[str, ast.AST] = {}
+        for name, fn in ctx.functions:
+            by_name.setdefault(name, fn)
+
+        out: List[Tuple[int, str]] = []
+        for name, fn in ctx.functions:
+            if not name.startswith(HANDLER_PREFIXES):
+                continue
+            params = _handler_params(fn)
+            uncapped = params - _capped_params(fn, params)
+            deadlines = _deadline_names(fn, uncapped, consts)
+
+            for lineno, what in _unbounded_primitive_waits(fn):
+                out.append((
+                    lineno,
+                    f"in {name}(): unbounded .{what}() holds a "
+                    f"dispatcher thread forever — pass a sliced timeout",
+                ))
+            for lineno, dl in _deadline_wait_loops(fn, deadlines):
+                out.append((
+                    lineno,
+                    f"in {name}(): wait loop runs to caller-supplied "
+                    f"deadline {dl!r} — cap server-side "
+                    f"(param = min(param, config.dispatch_wait_slice_s)) "
+                    f"and let callers re-issue slices",
+                ))
+            for lineno, p in _direct_param_waits(fn, uncapped, consts):
+                out.append((
+                    lineno,
+                    f"in {name}(): waits for caller-supplied {p!r} "
+                    f"without a server-side slice cap",
+                ))
+
+            # one call deep: uncapped deadline handed to a same-file
+            # helper that runs the wait loop (rpc_lease_worker ->
+            # _lease_wait)
+            dirty = uncapped | deadlines
+            if not dirty:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee_name = ""
+                if isinstance(node.func, ast.Attribute) and isinstance(
+                    node.func.value, ast.Name
+                ) and node.func.value.id == "self":
+                    callee_name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    callee_name = node.func.id
+                callee = by_name.get(callee_name)
+                if callee is None or callee is fn or not isinstance(
+                    callee, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                p = _callee_param_for_arg(node, callee, dirty)
+                if p is None:
+                    continue
+                callee_dl = {p} | _deadline_names(callee, {p}, consts)
+                hit = _deadline_wait_loops(callee, callee_dl) or \
+                    _direct_param_waits(callee, {p}, consts)
+                if hit:
+                    out.append((
+                        node.lineno,
+                        f"in {name}(): passes caller-supplied deadline "
+                        f"to {callee_name}(), whose wait loop (line "
+                        f"{hit[0][0]}) holds the dispatcher thread — "
+                        f"slice the wait server-side",
+                    ))
+        # de-dup (a loop can match several rules)
+        seen: Set[Tuple[int, str]] = set()
+        uniq = []
+        for item in out:
+            if item not in seen:
+                seen.add(item)
+                uniq.append(item)
+        return uniq
+
+
+PASS = DispatcherBlockPass()

@@ -1,0 +1,271 @@
+"""resource-leak pass: leak-prone resource creations must reach a
+cleanup or escape to an owner.
+
+The repo's recurring debris classes: ``/dev/shm/rtchan_*``/``rtshm_*``
+segments left by tests and crashed workers (PRs 3/8), tempfiles under
+``/tmp/ray_tpu``, non-daemon threads that outlive their owner and hang
+interpreter shutdown.  Python's GC closes none of these promptly — shm
+segments never, threads never.
+
+Tracked creations (per function):
+
+* ``tempfile.TemporaryFile/NamedTemporaryFile/mkstemp/mkdtemp/
+  TemporaryDirectory``;
+* ``threading.Thread(...)`` (``daemon=True`` is exempt — fire-and-forget
+  daemons are a deliberate pattern here);
+* ``socket.socket/create_connection/socketpair``;
+* ``mmap.mmap``;
+* channel plumbing: ``ShmChannel.create``, ``open_channel``, and
+  ``rpc_channel_handle`` mints (each pins an fd + an shm segment until
+  closed/unlinked).
+
+A creation is CLEAN when any of these holds, anywhere in the function
+(path-insensitive by design — try/finally placement is the reviewer's
+job, existence of a teardown is the machine's):
+
+* it happens in a ``with ...`` item, or the bound name is later used as
+  a context manager;
+* a cleanup method is called on the bound name (``close``, ``unlink``,
+  ``release``, ``stop``, ``shutdown``, ``join``, ``kill``,
+  ``terminate``, ``cancel``, ``destroy``, ``cleanup``);
+* the value ESCAPES to an owner: returned/yielded, stored into an
+  attribute/subscript (``self._threads[k] = t``), placed in a container
+  literal, or passed to ANY call (``os.close(fd)``,
+  ``registry.track(ch)``, ``shutil.rmtree(d)`` all count).
+
+A bound-and-then-ignored or entirely unbound creation
+(``threading.Thread(target=f).start()``) is flagged.  Suppress with
+``# rtlint: ignore[resource-leak] <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.rtlint.engine import FileContext, LintPass
+
+TEMPFILE_FNS = {
+    "TemporaryFile", "NamedTemporaryFile", "mkstemp", "mkdtemp",
+    "TemporaryDirectory",
+}
+SOCKET_FNS = {"socket", "create_connection", "socketpair"}
+CHANNEL_FNS = {"open_channel", "rpc_channel_handle"}
+CLEANUP_METHODS = {
+    "close", "unlink", "release", "stop", "shutdown", "join", "kill",
+    "terminate", "cancel", "destroy", "cleanup",
+}
+
+
+def _creator_kind(call: ast.Call) -> Optional[str]:
+    """Short resource description if this call creates a tracked
+    resource, else None."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        base, attr = f.value.id, f.attr
+        if base == "tempfile" and attr in TEMPFILE_FNS:
+            return f"tempfile.{attr}()"
+        if base == "threading" and attr == "Thread":
+            return "threading.Thread()"
+        if base == "socket" and attr in SOCKET_FNS:
+            return f"socket.{attr}()"
+        if base == "mmap" and attr == "mmap":
+            return "mmap.mmap()"
+        if base == "ShmChannel" and attr == "create":
+            return "ShmChannel.create()"
+        if attr in CHANNEL_FNS:
+            return f"{attr}()"
+    elif isinstance(f, ast.Name):
+        if f.id == "Thread":
+            return "Thread()"
+        if f.id in CHANNEL_FNS:
+            return f"{f.id}()"
+        if f.id in TEMPFILE_FNS:
+            return f"{f.id}()"
+    return None
+
+
+def _is_daemon_thread(call: ast.Call, kind: str) -> bool:
+    if "Thread" not in kind:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _parent_map(fn: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _bound_names(target: ast.AST) -> Optional[Set[str]]:
+    """Names bound when assigning the creation to ``target``; None means
+    the target itself is an escape (attribute/subscript store)."""
+    if isinstance(target, ast.Name):
+        return {target.id}
+    if isinstance(target, (ast.Tuple, ast.List)):
+        names: Set[str] = set()
+        for elt in target.elts:
+            if isinstance(elt, ast.Name):
+                names.add(elt.id)
+            else:
+                return None  # (self.a, b) = ... — stored somewhere
+        return names
+    return None  # Attribute / Subscript target: escapes to the owner
+
+
+def _name_is_handled(fn: ast.AST, names: Set[str],
+                     creation: ast.Call) -> bool:
+    """Does any bound name reach a cleanup, a with-block, or an escape
+    anywhere in the function?"""
+    for node in ast.walk(fn):
+        # with name: / with name as x:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Name) and ce.id in names:
+                    return True
+        if isinstance(node, ast.Call):
+            if node is creation:
+                continue
+            # cleanup method on the name
+            if (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in names
+                and node.func.attr in CLEANUP_METHODS
+            ):
+                return True
+            # passed to any call: ownership transferred
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(a):
+                    if isinstance(sub, ast.Name) and sub.id in names:
+                        return True
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = node.value
+            if v is not None and any(
+                isinstance(s, ast.Name) and s.id in names
+                for s in ast.walk(v)
+            ):
+                return True
+        if isinstance(node, ast.Assign):
+            if node.value is creation:
+                continue
+            rhs_names = {
+                s.id for s in ast.walk(node.value)
+                if isinstance(s, ast.Name)
+            }
+            if not (rhs_names & names):
+                continue
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    return True  # self._x = name — escapes to owner
+        # name placed in a container literal: stored for someone
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set, ast.Dict)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.Name) and sub.id in names:
+                    return True
+    return False
+
+
+class ResourceLeakPass(LintPass):
+    id = "resource-leak"
+    title = "leak-prone resource without teardown"
+    doc = ("shm channels / rpc_channel_handle mints / tempfiles / "
+           "started threads must reach close/unlink/join or escape to "
+           "an owner")
+
+    def select(self, relpath: str) -> bool:
+        return relpath.split(os.sep)[0] == "ray_tpu"
+
+    def run(self, ctx: FileContext) -> List[Tuple[int, str]]:
+        out: List[Tuple[int, str]] = []
+        seen: Set[int] = set()
+        for name, fn in ctx.functions:
+            parents = _parent_map(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _creator_kind(node)
+                if kind is None or node.lineno in seen:
+                    continue
+                if _is_daemon_thread(node, kind):
+                    continue
+                parent = parents.get(node)
+                # inside a nested def: that def's own walk handles it
+                owner = parent
+                nested = False
+                while owner is not None and owner is not fn:
+                    if isinstance(
+                        owner,
+                        (ast.FunctionDef, ast.AsyncFunctionDef),
+                    ):
+                        nested = True
+                        break
+                    owner = parents.get(owner)
+                if nested:
+                    continue
+                if isinstance(parent, ast.withitem):
+                    continue
+                if isinstance(parent, ast.Call):
+                    continue  # direct argument: ownership transferred
+                if isinstance(
+                    parent, (ast.Return, ast.Yield, ast.YieldFrom,
+                             ast.Await)
+                ):
+                    continue
+                if isinstance(parent, ast.Attribute):
+                    # method chained straight off the creation
+                    gp = parents.get(parent)
+                    if (
+                        isinstance(gp, ast.Call)
+                        and parent.attr in CLEANUP_METHODS
+                    ):
+                        continue
+                    seen.add(node.lineno)
+                    out.append((
+                        node.lineno,
+                        f"in {name}(): {kind} used without a handle — "
+                        f"bind it and close/unlink/join it (or hand it "
+                        f"to an owner)",
+                    ))
+                    continue
+                if isinstance(parent, ast.Assign):
+                    names: Optional[Set[str]] = None
+                    if node is parent.value:
+                        names = set()
+                        for t in parent.targets:
+                            b = _bound_names(t)
+                            if b is None:
+                                names = None  # escapes via target
+                                break
+                            names |= b
+                    if names is None:
+                        continue
+                    if _name_is_handled(fn, names, node):
+                        continue
+                    seen.add(node.lineno)
+                    out.append((
+                        node.lineno,
+                        f"in {name}(): {kind} bound to "
+                        f"{'/'.join(sorted(names))} never reaches "
+                        f"close/unlink/join and never escapes to an "
+                        f"owner — use try/finally or a context manager",
+                    ))
+                    continue
+                if isinstance(parent, ast.Expr):
+                    seen.add(node.lineno)
+                    out.append((
+                        node.lineno,
+                        f"in {name}(): {kind} created and discarded — "
+                        f"the resource leaks immediately",
+                    ))
+        return out
+
+
+PASS = ResourceLeakPass()
