@@ -5,29 +5,40 @@ tasks / many actors / many PGs / object broadcast).
 Each section prints one JSON line and the whole run writes
 BENCH_SCALE.json. Sized for this harness (one physical core): the point
 is that the control plane — owner queues, scheduler, lease protocol,
-data plane — survives the SHAPE of the reference envelope (tens of
-thousands of queued tasks, thousands of registered actors, hundreds of
-concurrent PGs, a multi-node broadcast) without storms or thread
-explosions, not that one core matches a 256-core cluster's absolute
-numbers.
+data plane — survives the SHAPE of the reference envelope (a million
+queued tasks, ten thousand registered actors, hundreds of concurrent
+PGs, a multi-node broadcast) without storms or thread explosions, not
+that one core matches a 256-core cluster's absolute numbers.
 
 Run: python bench_scale.py
+A/B: python bench_scale.py --r14-ab   (writes BENCH_r14.json)
+
+The --r14-ab mode isolates the PR 14 control-plane levers: leg A runs
+with client-side lifecycle batching and WAL group commit OFF
+(actor_batch_flush_ms=0, wal_group_commit_ms=0), leg B with both ON,
+both against a persistent control store so the per-op-fsync vs
+group-commit difference is visible. Legs are interleaved (A1, B1, A2,
+B2), each on a fresh cluster, so drift in the harness lands on both
+sides.
 """
 
 import json
+import sys
 import time
 
 RESULTS = {}
 
 
 def record(name, value, unit, **detail):
-    RESULTS[name] = {"value": round(value, 1), "unit": unit, **detail}
-    print(json.dumps({"metric": name, "value": round(value, 1),
+    # round(value, 4), not 1: sub-100 ms rows (kill-drain legs, alive
+    # pings) must record real ms-precision values instead of 0.0
+    RESULTS[name] = {"value": round(value, 4), "unit": unit, **detail}
+    print(json.dumps({"metric": name, "value": round(value, 4),
                       "unit": unit, **detail}), flush=True)
 
 
-def bench_many_tasks(n=100_000):
-    """100k tasks queued on one node (reference: 1M queued / 10k-running
+def bench_many_tasks(n=100_000, tag="100k"):
+    """Tasks queued on one node (reference: 1M queued / 10k-running
     envelope, release/benchmarks/README.md). Measures owner-side submit
     rate (tasks enter the lease-cache queue) and end-to-end drain."""
     import ray_tpu
@@ -41,21 +52,28 @@ def bench_many_tasks(n=100_000):
     t0 = time.perf_counter()
     refs = [nop.remote() for _ in range(n)]
     submit_dt = time.perf_counter() - t0
-    record("tasks_100k_submit", n / submit_dt, "tasks/s",
+    record(f"tasks_{tag}_submit", n / submit_dt, "tasks/s",
            queued=n)
     t0 = time.perf_counter()
     ray_tpu.get(refs)
     drain_dt = time.perf_counter() - t0
-    record("tasks_100k_drain", n / drain_dt, "tasks/s",
+    record(f"tasks_{tag}_drain", n / drain_dt, "tasks/s",
            wall_s=round(submit_dt + drain_dt, 1))
 
 
-def bench_many_actors(n_registered=2000, n_alive=48):
-    """2000 actors registered against bounded capacity (reference:
-    many_actors envelope). Most stay PENDING in the store's scheduler
-    queue — the test is that registration stays fast, the retry heap
-    doesn't melt, and alive actors still answer pings underneath the
-    pending pile; then a full kill drain."""
+def bench_many_actors(n_registered=2000, n_alive=48, tag="2000",
+                      ping_row=None, drain_timeout_s=600):
+    """Actors registered against bounded capacity (reference: many_actors
+    envelope). Most stay PENDING in the store's scheduler queue — the
+    test is that registration stays fast, the retry heap doesn't melt,
+    and alive actors still answer pings underneath the pending pile;
+    then a full kill drain.
+
+    Registration is client-batched (PR 14), so ``A.remote()`` returning
+    is not the same as the store having the record: the register row
+    times submit UNTIL the store lists all ``n_registered`` actors —
+    acked registrations per second, honest in both batched and legacy
+    (actor_batch_flush_ms=0) modes."""
     import ray_tpu
     from ray_tpu.core.worker import global_worker
 
@@ -70,20 +88,29 @@ def bench_many_actors(n_registered=2000, n_alive=48):
     alive_actors = [A.remote() for _ in range(n_alive)]
     ray_tpu.get([a.ping.remote() for a in alive_actors], timeout=600)
 
+    w = global_worker()
     t0 = time.perf_counter()
     actors = [A.remote() for _ in range(n_registered - n_alive)]
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if len(w.control.call("list_actors")) >= n_registered:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("registrations did not land in the store")
     reg_dt = time.perf_counter() - t0
-    record("actors_2000_register", (n_registered - n_alive) / reg_dt,
-           "actors/s")
+    record(f"actors_{tag}_register", (n_registered - n_alive) / reg_dt,
+           "actors/s", wall_s=round(reg_dt, 2))
 
-    # alive actors must still answer pings while ~2k pending actors churn
+    # alive actors must still answer pings while the pending mass churns
     # through the scheduler's retry heap
     t0 = time.perf_counter()
     alive = ray_tpu.get(
         [a.ping.remote() for a in alive_actors], timeout=600
     )
     assert sum(alive) == n_alive
-    record("actors_alive_under_load_ping_s", time.perf_counter() - t0, "s",
+    record(ping_row or f"actors_{tag}_alive_ping_s",
+           time.perf_counter() - t0, "s",
            alive=n_alive, pending=n_registered - n_alive)
     actors = alive_actors + actors
 
@@ -91,17 +118,16 @@ def bench_many_actors(n_registered=2000, n_alive=48):
     for a in actors:
         ray_tpu.kill(a)
     # drain: the store must settle (no pending actors left)
-    w = global_worker()
-    deadline = time.monotonic() + 300
+    deadline = time.monotonic() + drain_timeout_s
     while time.monotonic() < deadline:
         listing = w.control.call("list_actors")
         states = [a["state"] for a in listing]
         if all(s == "DEAD" for s in states):
             break
-        time.sleep(0.5)
+        time.sleep(0.2)
     else:
         raise AssertionError(f"actors did not drain: {set(states)}")
-    record("actors_2000_kill_drain_s", time.perf_counter() - t0, "s")
+    record(f"actors_{tag}_kill_drain_s", time.perf_counter() - t0, "s")
 
 
 def bench_many_pgs(n=200):
@@ -165,9 +191,16 @@ def main():
     import ray_tpu
 
     ray_tpu.init(num_cpus=48)
+    # the three historical sections first, in the seed's order, so their
+    # rows stay comparable against older BENCH_SCALE.json baselines; the
+    # PR 14 envelope rows (1M queued tasks, 10k actors) append after
     bench_many_tasks()
-    bench_many_actors()
+    bench_many_actors(
+        ping_row="actors_alive_under_load_ping_s"  # historical row name
+    )
     bench_many_pgs()
+    bench_many_tasks(n=1_000_000, tag="1m")
+    bench_many_actors(n_registered=10_000, n_alive=48, tag="10k")
     ray_tpu.shutdown()
     bench_broadcast()
     with open("BENCH_SCALE.json", "w") as f:
@@ -175,5 +208,50 @@ def main():
     print(json.dumps({"ok": True, "file": "BENCH_SCALE.json"}))
 
 
+def run_r14_ab(n_actors=1000, n_alive=48, rounds=2):
+    """Interleaved A/B of the PR 14 control-plane levers, against a
+    persistent store (the WAL fsync cadence is invisible without one).
+    Writes BENCH_r14.json keyed ``<row>@<leg>``."""
+    import shutil
+    import tempfile
+
+    import ray_tpu
+    from ray_tpu.utils.config import config
+
+    saved = {
+        "actor_batch_flush_ms": config.actor_batch_flush_ms,
+        "wal_group_commit_ms": config.wal_group_commit_ms,
+        "control_store_persistence_path":
+            config.control_store_persistence_path,
+    }
+    root = tempfile.mkdtemp(prefix="rt-r14-ab-")
+    legs = []
+    for i in range(1, rounds + 1):
+        legs += [(f"A{i}", False), (f"B{i}", True)]
+    try:
+        for leg, on in legs:
+            config.set("actor_batch_flush_ms", 2.0 if on else 0.0)
+            config.set("wal_group_commit_ms", 2.0 if on else 0.0)
+            config.set("control_store_persistence_path",
+                       f"{root}/{leg}/cs.db")
+            print(json.dumps({"leg": leg, "batch+group_commit": on}),
+                  flush=True)
+            ray_tpu.init(num_cpus=48)
+            try:
+                bench_many_actors(n_actors, n_alive, tag=f"{n_actors}@{leg}")
+            finally:
+                ray_tpu.shutdown()
+    finally:
+        for k, v in saved.items():
+            config.set(k, v)
+        shutil.rmtree(root, ignore_errors=True)
+    with open("BENCH_r14.json", "w") as f:
+        json.dump(RESULTS, f, indent=2)
+    print(json.dumps({"ok": True, "file": "BENCH_r14.json"}))
+
+
 if __name__ == "__main__":
-    main()
+    if "--r14-ab" in sys.argv[1:]:
+        run_r14_ab()
+    else:
+        main()

@@ -222,9 +222,10 @@ class ActorHandle:
 
             w = worker_mod.global_worker_or_none()
             if w is not None and not w._shutdown.is_set():
-                w.control.call_oneway(
-                    "actor_handle_dropped", actor_id=self._actor_id
-                )
+                # via the worker so the drop orders after a still-batched
+                # registration of this very actor (core/worker.py
+                # drop_actor_handle)
+                w.drop_actor_handle(self._actor_id)
         except Exception:  # noqa: BLE001 — interpreter teardown
             pass
 

@@ -74,6 +74,13 @@ _DURABLE_NODE_FIELDS = (
 # process restart.
 _VOLATILE_OPS = frozenset({"node_runtime"})
 
+# Dispatcher pipelining (ISSUE 14): extra already-queued items one
+# scheduler wakeup drains in the same pass, and the cap on async lease
+# RPCs fired per batched-arrival item (each spawns a per-request handler
+# thread on the target agent; the overflow parks in the retry heap).
+_SCHED_DRAIN_MAX = 64
+_SCHED_BATCH_FANOUT = 128
+
 
 class ControlStore:
     def __init__(self, session_id: str, host: str = "127.0.0.1", port: int = 0,
@@ -92,6 +99,7 @@ class ControlStore:
                 FileBackend(self._persistence_path),
                 compact_entries=int(config.ha_wal_compact_entries),
                 fsync=bool(config.ha_wal_fsync),
+                group_commit_ms=float(config.wal_group_commit_ms),
             )
         # Reconciliation window state (live failover): set by _restore when
         # previously-alive nodes were recovered from the log.
@@ -104,6 +112,10 @@ class ControlStore:
         self._server = RpcServer("control_store", host, port)
         self._server.register_instance(self)
         self._server.on_disconnect = self._handle_disconnect
+        if self._ha is not None and self._ha.group_commit:
+            # acked => durable under group commit: every reply waits for
+            # the group holding its ops to flush (wal.py HAState.barrier)
+            self._server.post_dispatch = self._ha.barrier
 
         self._lock = threading.RLock()
         self._kv: Dict[str, Dict[str, bytes]] = {}
@@ -112,6 +124,10 @@ class ControlStore:
         self._actors: Dict[str, Dict[str, Any]] = {}  # actor_id hex -> record
         self._named_actors: Dict[Tuple[str, str], str] = {}
         self._pgs: Dict[str, Dict[str, Any]] = {}
+        # woken on every PG terminal transition (CREATED/REMOVED) so
+        # rpc_wait_placement_group returns the moment the 2PC finishes
+        # instead of quantizing every waiter to a poll interval
+        self._pg_cv = threading.Condition(self._lock)
         self._jobs: Dict[str, Dict[str, Any]] = {}
         self._next_job = 1
 
@@ -156,6 +172,13 @@ class ControlStore:
             max_workers=2, thread_name_prefix="cs-pg"
         )
         self._pg_running: set = set()
+        # Parallel kill-drain (ISSUE 14): teardown RPCs (exit_worker +
+        # release_workers) fan out across node agents on this bounded
+        # pool instead of a serial per-actor loop in the handler thread.
+        self._kill_pool = ThreadPoolExecutor(
+            max_workers=max(1, int(config.actor_kill_fanout)),
+            thread_name_prefix="cs-kill",
+        )
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -180,6 +203,7 @@ class ControlStore:
     def stop(self) -> None:
         self._stopped.set()
         self._pg_pool.shutdown(wait=False)
+        self._kill_pool.shutdown(wait=False)
         # server down first, and the final snapshot under the store lock:
         # an in-flight handler must not append between the close's state
         # copy and its WAL truncation (the acked op would vanish). An
@@ -807,15 +831,20 @@ class ControlStore:
             # failure detection (reference: GCS treats the raylet channel
             # break as a death signal, not just missed heartbeats).
             conn.node_id = node_id
+            # Heartbeats call _mut_node_runtime DIRECTLY instead of going
+            # through _apply: node_runtime is in _VOLATILE_OPS (never
+            # WAL'd), so the choke point adds only dispatch overhead on
+            # the store's single hottest path — one call per node per
+            # beat. Cold-path node_runtime writers (register/reattach/
+            # restore) keep using _apply.
             if not node.get("reconciled", True):
                 # restored-from-log record: the agent must re-assert its
                 # leases/bundles/workers before scheduling trusts the node
-                self._apply("node_runtime", node_id,
-                            {"last_heartbeat": time.monotonic()})
+                self._mut_node_runtime(node_id, {"last_heartbeat": time.monotonic()})  # rtlint: ignore[wal-choke] volatile heartbeat field, _VOLATILE_OPS skips the WAL; hot path bypasses _apply dispatch
                 return {"ok": True, "reattach": True}
             runtime: Dict[str, Any] = {"last_heartbeat": time.monotonic()}
             if resources_available is None:
-                self._apply("node_runtime", node_id, runtime)
+                self._mut_node_runtime(node_id, runtime)  # rtlint: ignore[wal-choke] volatile heartbeat field, _VOLATILE_OPS skips the WAL; hot path bypasses _apply dispatch
                 if node.get("view_version") != view_version:
                     return {"ok": True, "resync": True}
                 return {"ok": True}
@@ -827,7 +856,7 @@ class ControlStore:
             })
             if extra:
                 runtime.update(extra)
-            self._apply("node_runtime", node_id, runtime)
+            self._mut_node_runtime(node_id, runtime)  # rtlint: ignore[wal-choke] volatile heartbeat runtime, _VOLATILE_OPS skips the WAL; hot path bypasses _apply dispatch
             self._view_version += 1
         return {"ok": True}
 
@@ -970,8 +999,7 @@ class ControlStore:
                 and a.get("lifetime") != "detached"
                 and a["state"] not in (ActorState.DEAD,)
             ]
-        for aid in doomed:
-            self._kill_actor_internal(aid, "job finished", no_restart=True)
+        self._kill_actors_internal(doomed, "job finished", no_restart=True)
         return True
 
     def rpc_list_jobs(self, conn):
@@ -989,29 +1017,68 @@ class ControlStore:
         resources, name/namespace, lifetime, max_restarts, max_concurrency,
         scheduling_strategy, owner_address.
         """
+        with self._lock:
+            err = self._register_actor_locked(spec)
+        if err is not None:
+            raise ValueError(err)
+        self._sched_enqueue(("actor", spec["actor_id"]))
+        return True
+
+    def rpc_register_actors(self, conn, specs: List[Dict[str, Any]]):
+        """Bulk registration (ISSUE 14): one RPC + ONE dispatcher wakeup
+        for a whole batch of actor specs. Results are per-record — a bad
+        spec (e.g. name conflict) reports its error without poisoning its
+        siblings. Each record still logs an individual `actor_register`
+        WAL op through _apply, so replay is identical whether specs
+        arrived batched or one at a time."""
+        results: List[Dict[str, Any]] = []
+        accepted: List[str] = []
+        with self._lock:
+            for spec in specs:
+                try:
+                    err = self._register_actor_locked(spec)
+                except Exception as e:  # noqa: BLE001 — malformed spec
+                    err = f"{type(e).__name__}: {e}"
+                if err is None:
+                    accepted.append(spec["actor_id"])
+                    results.append({"actor_id": spec.get("actor_id"), "ok": True})
+                else:
+                    results.append({
+                        "actor_id": spec.get("actor_id"), "ok": False,
+                        "error": err,
+                    })
+        if accepted:
+            self._sched_enqueue(("actors", accepted))
+        return results
+
+    def _register_actor_locked(self, spec: Dict[str, Any]) -> Optional[str]:
+        """Validate + apply one registration under the store lock. Returns
+        an error string (None = registered). Re-registering an existing
+        actor_id is idempotent-ok, so a retried batch cannot fail on the
+        records its first attempt already landed."""
         actor_id = spec["actor_id"]
+        if actor_id in self._actors:
+            return None  # duplicate delivery of a retried batch
         name = spec.get("name")
         ns = spec.get("namespace", "default")
-        with self._lock:
-            if name:
-                key = (ns, name)
-                if key in self._named_actors:
-                    existing = self._named_actors[key]
-                    if self._actors[existing]["state"] != ActorState.DEAD:
-                        raise ValueError(
-                            f"actor name {name!r} already taken in namespace {ns!r}"
-                        )
-            record = {
-                **spec,
-                "state": ActorState.PENDING_CREATION,
-                "num_restarts": 0,
-                "node_id": None,
-                "worker_address": None,
-                "death_cause": None,
-            }
-            self._apply("actor_register", record)
-        self._sched_enqueue(("actor", actor_id))
-        return True
+        if name:
+            key = (ns, name)
+            if key in self._named_actors:
+                existing = self._named_actors[key]
+                if self._actors[existing]["state"] != ActorState.DEAD:
+                    return (
+                        f"actor name {name!r} already taken in namespace {ns!r}"
+                    )
+        record = {
+            **spec,
+            "state": ActorState.PENDING_CREATION,
+            "num_restarts": 0,
+            "node_id": None,
+            "worker_address": None,
+            "death_cause": None,
+        }
+        self._apply("actor_register", record)
+        return None
 
     # -- scheduling queue (reference: GcsActorScheduler + PG scheduler on
     # -- the GCS io-service; one dispatcher, async RPC continuations) ----
@@ -1061,6 +1128,26 @@ class ControlStore:
         for it in items:
             self._sched_enqueue(it)
 
+    def _sched_purge(self, keys: set) -> None:
+        """Drop parked retry entries (and backoff state) for keys whose
+        entities just died. Without this a bulk kill leaves thousands of
+        dead actors' entries in the retry heap, and every subsequent
+        capacity kick (each lease grant/release fires one) re-enqueues
+        the whole pile — unrelated work (e.g. a PG bench right after a
+        kill drain) then queues FIFO behind hundreds of thousands of
+        no-op placement passes."""
+        with self._sched_retry_lock:
+            if self._sched_retries:
+                kept = [
+                    e for e in self._sched_retries
+                    if tuple(e[2][:2]) not in keys
+                ]
+                if len(kept) != len(self._sched_retries):
+                    self._sched_retries[:] = kept
+                    heapq.heapify(self._sched_retries)
+            for key in keys:
+                self._sched_backoff.pop(key, None)
+
     def _sched_loop(self) -> None:
         while not self._stopped.is_set():
             now = time.monotonic()
@@ -1078,31 +1165,63 @@ class ControlStore:
                 enq_ts, item = self._sched_q.get(timeout=max(timeout, 0.005))
             except queue.Empty:
                 continue
+            # Pipelined drain (ISSUE 14): take everything already queued
+            # in the same pass instead of one wakeup per item — under a
+            # burst (bulk register, mass kill) the per-wakeup overhead
+            # (metrics, retry-heap scan, queue round trip) amortizes over
+            # the burst instead of multiplying with it.
+            batch = [(enq_ts, item)]
+            while len(batch) < _SCHED_DRAIN_MAX:
+                try:
+                    batch.append(self._sched_q.get_nowait())
+                except queue.Empty:
+                    break
             if core_metrics.ENABLED:
                 core_metrics.sched_queue_depth.set(self._sched_q.qsize())
-                core_metrics.sched_dispatch_latency_s.observe(
-                    time.monotonic() - enq_ts, tags={"kind": str(item[0])}
-                )
-            try:
-                self._process_sched(item)
-            except Exception:  # noqa: BLE001 — scheduler must survive
-                logger.exception("scheduler item %r failed", item)
-                # never DROP a pending entity on a scheduling crash: retry
-                # with the key's backoff (capped), so a transient error
-                # (node died mid-pass) can't orphan an actor/PG forever
-                if item and item[0] in ("actor", "pg"):
-                    self._sched_retry(item, tuple(item[:2]))
+                now = time.monotonic()
+                for enq_ts, item in batch:
+                    core_metrics.sched_dispatch_latency_s.observe(
+                        now - enq_ts, tags={"kind": str(item[0])}
+                    )
+            for _, item in batch:
+                try:
+                    self._process_sched(item)
+                except Exception:  # noqa: BLE001 — scheduler must survive
+                    logger.exception("scheduler item %r failed", item)
+                    # never DROP a pending entity on a scheduling crash:
+                    # retry with the key's backoff (capped), so a transient
+                    # error (node died mid-pass) can't orphan an actor/PG
+                    if item and item[0] in ("actor", "pg"):
+                        self._sched_retry(item, tuple(item[:2]))
+                    elif item and item[0] == "actors":
+                        for aid in item[1]:
+                            self._sched_retry(("actor", aid), ("actor", aid))
 
     def _process_sched(self, item: tuple) -> None:
         kind = item[0]
-        if self._recovering and kind in ("actor", "pg"):
+        if self._recovering and kind in ("actor", "pg", "actors"):
             # reconciliation window: placement decisions wait until live
             # agents have re-asserted their leases/bundles — scheduling
             # against a half-reconciled view would double-place actors
-            self._sched_retry(item, tuple(item[:2]))
+            if kind == "actors":
+                for aid in item[1]:
+                    self._sched_retry(("actor", aid), ("actor", aid))
+            else:
+                self._sched_retry(item, tuple(item[:2]))
             return
         if kind == "actor":
             self._sched_actor_place(item[1])
+        elif kind == "actors":
+            # batched arrival (rpc_register_actors): one wakeup schedules
+            # the whole batch. Cap the async lease fan-out per pass — each
+            # fired place spawns a handler thread agent-side — and park
+            # the overflow in the retry heap, where capacity kicks and
+            # lease completions pull it forward (today's steady state).
+            ids = item[1]
+            for aid in ids[:_SCHED_BATCH_FANOUT]:
+                self._sched_actor_place(aid)
+            for aid in ids[_SCHED_BATCH_FANOUT:]:
+                self._sched_retry(("actor", aid), ("actor", aid))
         elif kind == "actor_lease":
             self._sched_actor_leased(*item[1:])
         elif kind == "actor_created":
@@ -1166,6 +1285,11 @@ class ControlStore:
                 # reconnect must not reap every actor on the node
                 bind_to_conn=False,
                 runtime_env=record.get("runtime_env"),
+                # this node was picked from the GLOBAL view above; the
+                # agent re-consulting the store for spillback would turn
+                # a capacity-freed retry burst into a get_cluster_view
+                # storm that parks every other RPC behind it
+                spillback=False,
             )
         except RpcError as e:
             logger.warning(
@@ -1356,6 +1480,101 @@ class ControlStore:
         self._kill_actor_internal(actor_id, "ray_tpu.kill", no_restart=no_restart)
         return True
 
+    def rpc_kill_actors(self, conn, actor_ids: List[str],
+                        no_restart: bool = True):
+        """Bulk kill (ISSUE 14): one lock pass applies every DEAD
+        transition (the `actor_update` mutations batch under a single
+        lock acquisition), then teardown RPCs fan out across node agents
+        on the bounded kill pool instead of the serial per-actor loop.
+        Per-record results; unknown/already-dead ids report ok (a retried
+        batch must be idempotent)."""
+        results = self._kill_actors_internal(
+            actor_ids, "ray_tpu.kill", no_restart=no_restart
+        )
+        return results
+
+    def _kill_actors_internal(self, actor_ids: List[str], reason: str,
+                              no_restart: bool) -> List[Dict[str, Any]]:
+        results: List[Dict[str, Any]] = []
+        doomed: List[Tuple[str, Any, Any, Any]] = []
+        with self._lock:
+            for actor_id in actor_ids:
+                record = self._actors.get(actor_id)
+                if record is None or record["state"] == ActorState.DEAD:
+                    results.append(
+                        {"actor_id": actor_id, "ok": True, "changed": False}
+                    )
+                    continue
+                if no_restart:
+                    self._apply("actor_update", actor_id, {
+                        "state": ActorState.DEAD, "death_cause": reason,
+                    })
+                doomed.append((
+                    actor_id,
+                    record.get("worker_address"),
+                    record.get("agent_address"),
+                    record.get("lease_id"),
+                ))
+                results.append(
+                    {"actor_id": actor_id, "ok": True, "changed": True}
+                )
+        if no_restart:
+            self._sched_purge({("actor", a) for a in actor_ids})
+        self._teardown_workers(doomed)
+        for actor_id, _, _, _ in doomed:
+            if no_restart:
+                self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
+                self.publish("actor", self._public_actor(actor_id))
+            else:
+                self._on_actor_worker_lost(actor_id, reason)
+        return results
+
+    def _teardown_workers(
+        self, doomed: List[Tuple[str, Any, Any, Any]]
+    ) -> None:
+        """Fan worker teardown out on the bounded kill pool: one
+        exit_worker oneway per worker, and the lease releases GROUPED per
+        agent into one bulk release_workers RPC. The submitting thread
+        never waits on an agent — in-flight is bounded by the pool size
+        (config.actor_kill_fanout), and a hung agent costs one pool slot
+        for the call timeout, not the whole drain."""
+        by_agent: Dict[str, List[str]] = {}
+        for _actor_id, worker_addr, agent_addr, lease_id in doomed:
+            if worker_addr:
+                self._submit_teardown(self._exit_worker_quiet, worker_addr)
+            if agent_addr and lease_id:
+                by_agent.setdefault(agent_addr, []).append(lease_id)
+        for agent_addr, lease_ids in by_agent.items():
+            self._submit_teardown(
+                self._release_leases_quiet, agent_addr, lease_ids
+            )
+
+    def _submit_teardown(self, fn, *args) -> None:
+        try:
+            self._kill_pool.submit(fn, *args)
+        except RuntimeError:  # pool shut down: store is stopping
+            pass
+
+    def _exit_worker_quiet(self, worker_addr: str) -> None:
+        try:
+            self._workers.get(worker_addr).call_oneway("exit_worker")
+        except RpcError:
+            pass
+        self._workers.drop(worker_addr)
+
+    def _release_leases_quiet(self, agent_addr: str, lease_ids: List[str]) -> None:
+        try:
+            self._agents.get(agent_addr).call(
+                "release_workers", lease_ids=lease_ids, kill=True,
+                timeout_s=10.0,
+            )
+        except RpcError as e:
+            # agent dead/hung: its health-check death reaps the leases
+            logger.warning(
+                "bulk release of %d lease(s) on %s failed: %s",
+                len(lease_ids), agent_addr, e,
+            )
+
     def rpc_actor_handle_dropped(self, conn, actor_id: str):
         """The original handle went out of scope: GC the actor unless it is
         detached (parity: GcsActorManager handle-count GC)."""
@@ -1381,6 +1600,8 @@ class ControlStore:
                 self._apply("actor_update", actor_id, {
                     "state": ActorState.DEAD, "death_cause": reason,
                 })
+        if no_restart:
+            self._sched_purge({("actor", actor_id)})
         if worker_addr:
             try:
                 self._workers.get(worker_addr).call_oneway("exit_worker")
@@ -1420,6 +1641,8 @@ class ControlStore:
                     "state": ActorState.DEAD, "death_cause": reason,
                 })
                 restart = False
+        if not restart:
+            self._sched_purge({("actor", actor_id)})
         self.publish(f"actor:{actor_id}", self._public_actor(actor_id))
         self.publish("actor", self._public_actor(actor_id))
         if restart:
@@ -1494,6 +1717,7 @@ class ControlStore:
                 if pg is None or pg["state"] == PGState.REMOVED:
                     return
                 self._apply("pg_update", pg_id, {"state": PGState.CREATED})
+                self._pg_cv.notify_all()
             self._sched_backoff.pop(key, None)
             self.publish(f"pg:{pg_id}", {"pg_id": pg_id, "state": PGState.CREATED})
             return
@@ -1585,17 +1809,20 @@ class ControlStore:
         # sliced server-side: clients loop (placement.PlacementGroup.wait)
         wait_s = min(wait_s, float(config.dispatch_wait_slice_s))
         deadline = time.monotonic() + wait_s
-        while time.monotonic() < deadline:
-            with self._lock:
+        with self._lock:
+            while True:
                 pg = self._pgs.get(pg_id)
                 if pg is None:
                     return None
                 if pg["state"] in (PGState.CREATED, PGState.REMOVED):
                     return dict(pg)
-            time.sleep(0.02)
-        with self._lock:
-            pg = self._pgs.get(pg_id)
-            return dict(pg) if pg else None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return dict(pg)
+                # CV, not a sleep-poll: a poll interval quantizes EVERY
+                # wait that arrives before the 2PC finishes to a full
+                # tick (200 PGs x 20ms was half the many-PGs bench)
+                self._pg_cv.wait(remaining)
 
     def rpc_remove_placement_group(self, conn, pg_id: str):
         with self._lock:
@@ -1603,6 +1830,7 @@ class ControlStore:
             if pg is None:
                 return False
             self._apply("pg_update", pg_id, {"state": PGState.REMOVED})
+            self._pg_cv.notify_all()
             locations = dict(pg["bundle_locations"])
             view = self._cluster_view_locked()
         for node_id in set(locations.values()):

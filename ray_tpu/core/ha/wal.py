@@ -27,6 +27,8 @@ import logging
 import os
 import pickle
 import struct
+import threading
+import time
 import zlib
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
@@ -119,15 +121,34 @@ class FileBackend:
                     logger.exception("WAL record unpickle failed; stopping")
                     return
 
+    @staticmethod
+    def wal_frame(record: Tuple[int, str, tuple]) -> bytes:
+        """Serialize one record into its on-disk frame (crc + len + body).
+        Framing is identical whether the frame is written alone or as part
+        of a group, so group commit changes WAL bytes only in write-call
+        granularity, never in content."""
+        payload = pickle.dumps(record)
+        return _HDR.pack(zlib.crc32(payload), len(payload)) + payload
+
     def wal_append(self, record: Tuple[int, str, tuple],
                    fsync: bool = False) -> None:
+        self._wal_write(self.wal_frame(record), fsync)
+
+    def wal_append_frames(self, frames: List[bytes],
+                          fsync: bool = False) -> None:
+        """Group commit: land many frames as ONE buffered write (+ at most
+        one fsync). Because the group is a single contiguous write of
+        whole frames, a crash can only tear the tail — replay recovers a
+        clean frame prefix, never a partial mid-group record."""
+        self._wal_write(b"".join(frames), fsync)
+
+    def _wal_write(self, data: bytes, fsync: bool) -> None:
         if self._wal_f is None:
             os.makedirs(
                 os.path.dirname(os.path.abspath(self.wal_path)), exist_ok=True
             )
             self._wal_f = open(self.wal_path, "ab")
-        payload = pickle.dumps(record)
-        self._wal_f.write(_HDR.pack(zlib.crc32(payload), len(payload)) + payload)
+        self._wal_f.write(data)
         self._wal_f.flush()
         if fsync:
             os.fsync(self._wal_f.fileno())
@@ -158,6 +179,18 @@ class HAState:
     The caller (control store) serializes calls: ``append`` runs under the
     store lock, so records are totally ordered and a compaction snapshot
     taken inline is consistent with the log position.
+
+    Group commit (``group_commit_ms > 0``): ``append`` frames the record
+    and buffers it instead of writing; one flusher thread lands the
+    accumulated frames as a single buffered write (+ one fsync when
+    configured) per window. Durability for callers comes from
+    ``barrier()`` — the control store invokes it before every RPC reply,
+    so the per-op contract "acked implies in the WAL" is unchanged; a
+    barrier also cuts the window short, so a lone synchronous writer pays
+    one thread handoff, not a full window. Crash atomicity falls out of
+    the framing: the group is one contiguous write, so a torn tail is
+    always a whole-frame prefix and replay recovers exactly the applied
+    prefix.
     """
 
     def __init__(
@@ -165,10 +198,12 @@ class HAState:
         backend: FileBackend,
         compact_entries: int = 1000,
         fsync: bool = False,
+        group_commit_ms: float = 0.0,
     ):
         self.backend = backend
         self.compact_entries = max(1, int(compact_entries))
         self.fsync = fsync
+        self.group_commit_ms = max(0.0, float(group_commit_ms))
         self.epoch = 0  # number of recoveries this store's state survived
         self.seq = 0  # last op sequence number handed out
         self.meta: Dict[str, Any] = {}
@@ -176,6 +211,21 @@ class HAState:
         self._appended = 0
         self._compactions = 0
         self._replayed = 0
+        # group-commit state, all guarded by _group_cv's lock
+        self._group_cv = threading.Condition(threading.Lock())
+        self._group_buf: List[bytes] = []
+        self._group_top = 0  # highest seq sitting in the buffer
+        self._durable_seq = 0  # highest seq flushed (or folded in a snapshot)
+        self._group_urgent = False
+        self._group_stop = False
+        self._group_err: Optional[BaseException] = None
+        self._group_thread: Optional[threading.Thread] = None
+        self._groups_flushed = 0
+        self._tls = threading.local()
+
+    @property
+    def group_commit(self) -> bool:
+        return self.group_commit_ms > 0
 
     # -- recovery --
 
@@ -245,11 +295,114 @@ class HAState:
             self._snapshot(state_fn)
             self._compactions += 1
         self.seq += 1
-        self.backend.wal_append((self.seq, op, args), fsync=self.fsync)
+        if self.group_commit:
+            frame = self.backend.wal_frame((self.seq, op, args))
+            with self._group_cv:
+                if self._group_err is not None:
+                    # the flusher hit a disk error: earlier buffered ops
+                    # may be lost — refuse new appends so nothing acks
+                    raise self._group_err
+                self._group_buf.append(frame)
+                self._group_top = self.seq
+                if self._group_thread is None:
+                    self._group_thread = threading.Thread(
+                        target=self._group_loop, name="wal-group", daemon=True
+                    )
+                    self._group_thread.start()
+                self._group_cv.notify_all()
+            self._tls.last_seq = self.seq
+        else:
+            self.backend.wal_append((self.seq, op, args), fsync=self.fsync)
         self._appended += 1
         self._since_snapshot += 1
 
+    def barrier(self, timeout_s: float = 30.0) -> None:
+        """Block until every record THIS thread appended is flushed (and
+        fsynced when ``fsync`` is on). The control store calls this from
+        the RPC server's post-dispatch hook — i.e. after the handler ran
+        but before the reply is sent — so a caller that sees an ack sees
+        a durable op, exactly as with per-op appends. A waiting barrier
+        marks the group urgent, which makes the flusher skip the rest of
+        the window. No-op when group commit is off or this thread has not
+        appended anything new."""
+        if not self.group_commit:
+            return
+        last = getattr(self._tls, "last_seq", 0)
+        if last <= self._durable_seq:  # lock-free fast path (int read)
+            return
+        deadline = time.monotonic() + timeout_s
+        with self._group_cv:
+            while last > self._durable_seq:
+                if self._group_err is not None:
+                    raise self._group_err
+                if self._group_stop:
+                    return
+                self._group_urgent = True
+                self._group_cv.notify_all()
+                self._group_cv.wait(0.5)
+                if time.monotonic() >= deadline:
+                    raise OSError("WAL group-commit flush timed out")
+
+    def _group_loop(self) -> None:
+        window = self.group_commit_ms / 1000.0
+        with self._group_cv:
+            while True:
+                while not self._group_buf and not self._group_stop:
+                    self._group_cv.wait(1.0)
+                if self._group_stop and not self._group_buf:
+                    return
+                if not self._group_urgent and not self._group_stop:
+                    # let a group accumulate; an arriving barrier (urgent)
+                    # notifies and cuts this short
+                    self._group_cv.wait(window)
+                self._flush_group_locked()
+
+    def _flush_group_locked(self) -> None:
+        """Write the buffered group. Runs with _group_cv held: appenders
+        already serialize on the store lock, and barrier waiters would
+        only be waiting on this very write."""
+        frames, self._group_buf = self._group_buf, []
+        top = self._group_top
+        self._group_urgent = False
+        if not frames:
+            return
+        try:
+            self.backend.wal_append_frames(frames, fsync=self.fsync)
+        except Exception as e:  # noqa: BLE001
+            self._group_err = e
+            logger.exception(
+                "WAL group flush failed — store will refuse further appends"
+            )
+            self._group_cv.notify_all()
+            return
+        if top > self._durable_seq:
+            self._durable_seq = top
+        self._groups_flushed += 1
+        self._group_cv.notify_all()
+
     def _snapshot(self, state_fn: Callable[[], Dict[str, Any]]) -> None:
+        if self.group_commit:
+            # Hold the group lock across snapshot+reset so the flusher
+            # cannot race wal_reset's file-handle swap. Every buffered op
+            # is already APPLIED (append precedes its mutation and the
+            # store lock serializes _apply), so state_fn() folds the
+            # buffer into the snapshot; discard it only AFTER the
+            # snapshot is durably renamed, then everything up to seq is
+            # durable and waiting barriers can be released.
+            with self._group_cv:
+                self._write_snapshot_locked(state_fn)
+                self._group_buf = []
+                self._group_urgent = False
+                if self.seq > self._durable_seq:
+                    self._durable_seq = self.seq
+                self._group_cv.notify_all()
+        else:
+            self._write_snapshot_locked(state_fn)
+        self._since_snapshot = 0
+
+    def _write_snapshot_locked(
+        self, state_fn: Callable[[], Dict[str, Any]]
+    ) -> None:
         self.backend.write_snapshot({
             "version": SNAPSHOT_VERSION,
             "epoch": self.epoch,
@@ -258,7 +411,6 @@ class HAState:
             "tables": state_fn(),
         })
         self.backend.wal_reset()
-        self._since_snapshot = 0
 
     def close(self, state_fn: Optional[Callable[[], Dict[str, Any]]] = None) -> None:
         if state_fn is not None:
@@ -266,6 +418,13 @@ class HAState:
                 self._snapshot(state_fn)
             except OSError:
                 logger.exception("final HA snapshot failed")
+        if self.group_commit:
+            with self._group_cv:
+                self._group_stop = True
+                self._group_cv.notify_all()
+            t = self._group_thread
+            if t is not None:
+                t.join(timeout=5.0)
         self.backend.close()
 
     def stats(self) -> Dict[str, Any]:
@@ -275,5 +434,8 @@ class HAState:
             "wal_since_snapshot": self._since_snapshot,
             "wal_replayed": self._replayed,
             "compactions": self._compactions,
+            "wal_group_commit_ms": self.group_commit_ms,
+            "wal_groups_flushed": self._groups_flushed,
+            "wal_durable_seq": self._durable_seq,
             "snapshot_path": self.backend.snapshot_path,
         }

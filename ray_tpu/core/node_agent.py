@@ -30,7 +30,7 @@ import sys
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import scheduling
 from ray_tpu.core.object_store import ShmObjectStore
@@ -41,6 +41,13 @@ from ray_tpu.utils.ids import NodeID
 from ray_tpu.utils.rpc import RpcClient, RpcError, RpcServer
 
 logger = logging.getLogger(__name__)
+
+# Tolerance for resource-counter comparisons. Fractional requests (PG
+# bundles like {"CPU": 0.01}) are not exactly representable in binary
+# floating point, so long allocate/credit churn leaves ~1e-13 dust per
+# cycle in the availability counters; an exact >= would then starve
+# whole-unit requests on an idle node.
+_RES_EPS = 1e-9
 
 
 class _Worker:
@@ -118,6 +125,10 @@ class NodeAgent:
         # resource shapes recently starved for (shape key -> last seen):
         # heartbeats report entries younger than the TTL
         self._starved_shapes: Dict[tuple, float] = {}
+        # short-TTL cluster-view cache for the spillback consult
+        # (_pick_target_node) — one fetch serves a whole lease storm
+        self._view_cache_lock = threading.Lock()
+        self._view_cache: Tuple[float, Any] = (0.0, None)
         # versioned-sync counters (observability for the delta protocol)
         self._hb_full = 0
         self._hb_light = 0
@@ -532,7 +543,10 @@ class NodeAgent:
     # ------------------------------------------------------------------
 
     def _spawn_worker(self, kind: str = "cpu", env_spec=None,
-                      env_hash: str = "") -> None:
+                      env_hash: str = "", slot_reserved: bool = False) -> None:
+        """slot_reserved: the caller already counted this spawn in
+        _pending_spawns (under _lock, before the fork) so the spawn gate
+        can't be double-passed during the ~100ms Popen window."""
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env = dict(os.environ)
         pythonpath = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -584,8 +598,9 @@ class NodeAgent:
         stderr.close()
         _PROC_REGISTRY[proc.pid] = proc
         _PROC_LOGS[proc.pid] = log_base
-        with self._lock:
-            self._pending_spawns += 1
+        if not slot_reserved:
+            with self._lock:
+                self._pending_spawns += 1
         threading.Thread(
             target=self._reap_worker, args=(proc,), name="agent-reap", daemon=True
         ).start()
@@ -661,6 +676,7 @@ class NodeAgent:
         wait_s: float = 30.0,
         bind_to_conn: bool = True,
         runtime_env=None,
+        spillback: bool = True,
     ):
         """bind_to_conn: a lease granted to a driver/executor (the lease
         cache) dies with its owner's RPC connection — an owner that exits
@@ -668,13 +684,26 @@ class NodeAgent:
         workers forever. The control store passes False: actor leases are
         store-managed (actor death/restart flows release them), and a
         transient store->agent reconnect must NOT kill every actor on the
-        node."""
+        node.
+
+        spillback=False: the control store's actor scheduler already
+        picked this node from the GLOBAL cluster view, so re-consulting
+        the store here would only amplify load — a capacity-freed kick
+        retries every parked actor at once, and thousands of lease
+        requests each calling get_cluster_view back to the store queue
+        ahead of everything else on the store's dispatcher (ISSUE 14:
+        the 10k kill-drain stalled 30s exactly this way)."""
         resources = {k: float(v) for k, v in (resources or {}).items() if v}
         if core_metrics.ENABLED:
             core_metrics.lease_requests.inc()
         # Cluster-level decision: can/should this run here? (spillback)
         if bundle is None:
-            target = self._pick_target_node(resources, strategy)
+            if spillback:
+                target = self._pick_target_node(resources, strategy)
+            else:
+                # store-scheduled: the caller already picked this node
+                # from the global view — treat it as the target
+                target = {"node_id": self.node_id.hex()}
             if target is not None and target["node_id"] != self.node_id.hex():
                 return {"granted": False, "spillback": target["address"]}
             if target is None and not self._feasible_locally(resources):
@@ -805,17 +834,45 @@ class NodeAgent:
                         # demand DID fit the resources (ok was True), so
                         # zero/fractional-CPU requests past the capacity
                         # cap must still make progress — the cap only
-                        # throttles CONCURRENT spawns from retry storms
+                        # throttles CONCURRENT spawns from retry storms.
+                        # Zero-wait requests (the store scheduler's
+                        # fire-and-forget retries) can never use their own
+                        # spawn — it is purely a spawn-AHEAD for a later
+                        # retry — so they slow-start (at most max(2,
+                        # n_kind) in flight, doubling as workers register)
+                        # instead of fork-bombing up to cap at once: after
+                        # a mass kill, the straggler retries of
+                        # already-dead actors otherwise spawn a full
+                        # pool's worth of workers nobody will use, and
+                        # the fork storm convoys every other RPC on the
+                        # node (PG prepares, lease releases) for seconds
+                        limit = cap
+                        if deadline <= time.monotonic():
+                            limit = min(cap, max(2, n_kind))
                         if evicted is not None or self._pending_spawns == 0 or (
-                            n_kind + self._pending_spawns < cap
+                            n_kind + self._pending_spawns < limit
                         ):
+                            # reserve the slot BEFORE dropping the lock:
+                            # the fork takes ~100ms and an unreserved
+                            # gate would let every concurrently-parked
+                            # request pass it in that window
+                            self._pending_spawns += 1
+                            spawned = False
                             self._lock.release()
                             try:
                                 if evicted is not None:
                                     self._terminate_worker(evicted)
-                                self._spawn_worker(kind, env_spec, env_hash)
+                                self._spawn_worker(
+                                    kind, env_spec, env_hash,
+                                    slot_reserved=True,
+                                )
+                                spawned = True
                             finally:
                                 self._lock.acquire()
+                                if not spawned:
+                                    self._pending_spawns = max(
+                                        0, self._pending_spawns - 1
+                                    )
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return {"granted": False, "error": "lease timeout"}
@@ -906,6 +963,38 @@ class NodeAgent:
         self._notify_capacity_freed()
         return True
 
+    def rpc_release_workers(self, conn, lease_ids: List[str],
+                            kill: bool = False):
+        """Bulk lease release (ISSUE 14 kill-drain): one lock pass frees
+        every lease's resources, workers terminate outside the lock, and
+        the whole batch sends ONE capacity kick instead of one per lease.
+        Returns the number of leases actually released (unknown ids are
+        skipped — releases are idempotent)."""
+        released = 0
+        doomed_workers = []
+        with self._lock:
+            for lease_id in lease_ids:
+                info = self._leases.pop(lease_id, None)
+                if info is None:
+                    continue
+                released += 1
+                self._release_resources_locked(info)
+                worker = self._workers.get(info["worker_id"])
+                if worker is not None:
+                    if kill:
+                        self._workers.pop(worker.worker_id, None)
+                        doomed_workers.append(worker)
+                    else:
+                        worker.state = "idle"
+                        worker.lease_id = None
+            if released:
+                self._cv.notify_all()
+        for worker in doomed_workers:
+            self._terminate_worker(worker)
+        if released:
+            self._notify_capacity_freed()
+        return released
+
     def _release_resources_locked(self, info: Dict[str, Any]) -> None:
         self._deallocate_locked(info["resources"], info["bundle"])
 
@@ -924,6 +1013,28 @@ class NodeAgent:
         except RpcError:
             pass  # heartbeat anti-entropy covers the lost kick
 
+    @staticmethod
+    def _fits(pool, need) -> bool:
+        """Epsilon-tolerant resource fit: repeated fractional
+        allocate/credit cycles (e.g. 400 PG carve-outs of 0.01 CPU)
+        leave float dust in the availability counters, and an exact >=
+        would then refuse a whole-CPU request forever on a node that is
+        arithmetically idle."""
+        return all(
+            pool.get(k, 0.0) >= v - _RES_EPS for k, v in need.items()
+        )
+
+    def _credit_main_locked(self, resources) -> None:
+        """Credit the node pool, snapping each counter back to the node
+        total when it lands within epsilon — the dust from fractional
+        churn must not accumulate across workload generations."""
+        for k, v in resources.items():
+            avail = self.resources_available.get(k, 0.0) + v
+            total = self.resources_total.get(k, 0.0)
+            if abs(avail - total) < 1e-6:
+                avail = total
+            self.resources_available[k] = avail
+
     def _try_allocate_locked(self, resources, bundle):
         """Returns (ok, resolved_bundle). resolved_bundle pins the concrete
         pool index an index=-1 bundle request landed in, so release returns
@@ -940,22 +1051,22 @@ class NodeAgent:
             for k, v in resources.items():
                 pool[k] = pool.get(k, 0.0) - v
             return True, (pg_id, pool_idx)
-        if not all(self.resources_available.get(k, 0.0) >= v for k, v in resources.items()):
+        if not self._fits(self.resources_available, resources):
             return False, None
         for k, v in resources.items():
-            self.resources_available[k] = self.resources_available.get(k, 0.0) - v
+            left = self.resources_available.get(k, 0.0) - v
+            # the epsilon fit may leave -1e-12 dust; never go negative
+            self.resources_available[k] = left if left > 0.0 else 0.0
         return True, None
 
     def _bundle_pool_index(self, rec, idx, resources) -> Optional[int]:
         if idx is not None and idx >= 0:
             pool = rec["available"].get(idx)
-            if pool is not None and all(
-                pool.get(k, 0.0) >= v for k, v in resources.items()
-            ):
+            if pool is not None and self._fits(pool, resources):
                 return idx
             return None
         for i, pool in sorted(rec["available"].items()):
-            if all(pool.get(k, 0.0) >= v for k, v in resources.items()):
+            if self._fits(pool, resources):
                 return i
         return None
 
@@ -972,8 +1083,7 @@ class NodeAgent:
             for k, v in resources.items():
                 pool[k] = pool.get(k, 0.0) + v
             return
-        for k, v in resources.items():
-            self.resources_available[k] = self.resources_available.get(k, 0.0) + v
+        self._credit_main_locked(resources)
 
     def _pop_idle_worker_locked(self, kind: str = "cpu",
                                 env_hash: str = "") -> Optional[_Worker]:
@@ -1039,11 +1149,24 @@ class NodeAgent:
         return {"node_id": node_id, "address": view[node_id]["address"]}
 
     def _pick_target_node(self, resources, strategy):
-        """Cluster view consult for spillback (reference hybrid policy)."""
-        try:
-            view = self._control.call("get_cluster_view", timeout_s=5.0)
-        except RpcError:
-            return None
+        """Cluster view consult for spillback (reference hybrid policy).
+        The view is cached for a beat: a task-submission storm funnels
+        every lease request through this consult, and re-fetching the
+        view per request turns one storm into a second one aimed at the
+        control store. Spillback targets computed on a ≤100 ms-stale
+        view are already racy by nature (the view is a snapshot); a
+        wrong pick costs one extra hop."""
+        now = time.monotonic()
+        with self._view_cache_lock:
+            ts, cached = self._view_cache
+            view = cached if now - ts < 0.1 else None
+        if view is None:
+            try:
+                view = self._control.call("get_cluster_view", timeout_s=5.0)
+            except RpcError:
+                return None
+            with self._view_cache_lock:
+                self._view_cache = (now, view)
         node_id = scheduling.pick_node(
             view, resources, strategy, local_node_id=self.node_id.hex()
         )
@@ -1077,23 +1200,22 @@ class NodeAgent:
                 for b in bundles.values():
                     for k, v in b.items():
                         need[k] = need.get(k, 0.0) + v
-                if not all(
-                    self.resources_available.get(k, 0.0) >= v
-                    for k, v in need.items()
-                ):
+                if not self._fits(self.resources_available, need):
                     return False
                 for k, v in need.items():
-                    self.resources_available[k] -= v
+                    left = self.resources_available.get(k, 0.0) - v
+                    self.resources_available[k] = left if left > 0.0 else 0.0
                 existing["staged"] = bundles
                 return True
             need = {}
             for b in bundles.values():
                 for k, v in b.items():
                     need[k] = need.get(k, 0.0) + v
-            if not all(self.resources_available.get(k, 0.0) >= v for k, v in need.items()):
+            if not self._fits(self.resources_available, need):
                 return False
             for k, v in need.items():
-                self.resources_available[k] -= v
+                left = self.resources_available.get(k, 0.0) - v
+                self.resources_available[k] = left if left > 0.0 else 0.0
             self._bundles[pg_id] = {
                 "state": "prepared",
                 "bundles": {i: dict(b) for i, b in bundles.items()},
@@ -1145,8 +1267,7 @@ class NodeAgent:
                 if spec is None:
                     continue
                 rec["available"].pop(i, None)
-                for k, v in spec.items():
-                    self.resources_available[k] = self.resources_available.get(k, 0.0) + v
+                self._credit_main_locked(spec)
             if not rec["bundles"] and not staged:
                 self._bundles.pop(pg_id, None)
             self._cv.notify_all()

@@ -475,6 +475,13 @@ class CoreWorker:
         self._task_submitters_lock = threading.Lock()
         self._submitter_janitor: Optional[threading.Thread] = None
         self._actor_addr_cache: Dict[str, str] = {}
+        # lifecycle batching (ISSUE 14): created on first use when
+        # actor_batch_flush_ms > 0; kill_actor records the id here so a
+        # task submitted right after a (still-queued) kill fails with
+        # ActorDiedError deterministically instead of racing the flush
+        self._lifecycle_batcher: Optional[_ActorLifecycleBatcher] = None
+        self._lifecycle_batcher_lock = threading.Lock()
+        self._locally_killed: set = set()
 
         self._actor_runtime: Optional[_ActorRuntime] = None
         self._current_ctx = threading.local()
@@ -645,6 +652,10 @@ class CoreWorker:
             except Exception:  # noqa: BLE001 — teardown path
                 pass
         self._shutdown.set()
+        if self._lifecycle_batcher is not None:
+            # ship still-queued registrations/kills before the control
+            # connection goes away
+            self._lifecycle_batcher.close()
         self._submit_pool.shutdown(wait=False)
         self.server.stop()
         self.control.close()
@@ -2012,12 +2023,47 @@ class CoreWorker:
             # borrows must survive until the actor is PERMANENTLY dead
             self._restartable_actor_inits.add(actor_id)
         try:
-            self.control.call("register_actor", spec=spec, retryable=True)
+            batcher = self._actor_batcher()
+            if batcher is not None:
+                batcher.enqueue_register(spec)
+                if spec.get("name"):
+                    # named creation keeps synchronous semantics: a name
+                    # conflict must raise HERE, not at first use
+                    batcher.wait_registered(actor_id)
+            else:
+                self.control.call("register_actor", spec=spec, retryable=True)
         except BaseException:
             self._restartable_actor_inits.discard(actor_id)
             self._release_arg_pins(f"actor_init_{actor_id}")
             raise
         return actor_id
+
+    def _actor_batcher(self) -> Optional["_ActorLifecycleBatcher"]:
+        """The lifecycle batcher, or None when batching is off
+        (actor_batch_flush_ms=0 — the legacy one-RPC-per-actor path)."""
+        if float(config.actor_batch_flush_ms) <= 0:
+            return None
+        b = self._lifecycle_batcher
+        if b is None:
+            with self._lifecycle_batcher_lock:
+                b = self._lifecycle_batcher
+                if b is None:
+                    b = self._lifecycle_batcher = _ActorLifecycleBatcher(self)
+        return b
+
+    def _await_actor_registered(self, actor_id: str,
+                                timeout_s: float = 60.0) -> None:
+        """Surface a batched registration's per-record error (no-op for
+        ids registered synchronously or long since flushed)."""
+        b = self._lifecycle_batcher
+        if b is None:
+            return
+        try:
+            b.wait_registered(actor_id, timeout_s)
+        except BaseException:
+            self._restartable_actor_inits.discard(actor_id)
+            self._release_arg_pins(f"actor_init_{actor_id}")
+            raise
 
     def _actor_sender(self, actor_id: str) -> "_ActorSender":
         with self._actor_senders_lock:
@@ -2032,9 +2078,14 @@ class CoreWorker:
         creation / restart / resource queuing can legitimately take long —
         reference callers block on the GCS actor table the same way, but
         the timeout bounds the WHOLE wait, not each control-store call)."""
+        if actor_id in self._locally_killed:
+            # killed from this process: the kill may still be riding the
+            # lifecycle batch, but its outcome is already decided
+            raise ActorDiedError(f"actor {actor_id} was killed")
         addr = self._actor_addr_cache.get(actor_id)
         if addr:
             return addr
+        self._await_actor_registered(actor_id, timeout_s=timeout_s)
         deadline = time.monotonic() + timeout_s
         while True:
             remaining = max(0.05, deadline - time.monotonic())
@@ -2067,6 +2118,12 @@ class CoreWorker:
         n = self._actor_retry_cache.get(actor_id)
         if n is not None:
             return n
+        try:
+            # a batched registration may still be in flight; get_actor_info
+            # on an unknown actor would silently report 0 retries
+            self._await_actor_registered(actor_id, timeout_s=30.0)
+        except Exception:  # noqa: BLE001 — submission surfaces the error
+            pass
         try:
             info = self.control.call("get_actor_info", actor_id=actor_id)
             n = int((info or {}).get("max_task_retries") or 0)
@@ -2157,11 +2214,34 @@ class CoreWorker:
         )
 
     def kill_actor(self, actor_id: str, no_restart: bool = True) -> None:
-        self.control.call("kill_actor", actor_id=actor_id, no_restart=no_restart)
+        if no_restart:
+            # record BEFORE the (possibly batched) RPC: a submit racing
+            # the flush must observe the kill deterministically
+            self._locally_killed.add(actor_id)
+        batcher = self._actor_batcher()
+        if batcher is not None:
+            batcher.enqueue_kill(actor_id, no_restart)
+        else:
+            self.control.call(
+                "kill_actor", actor_id=actor_id, no_restart=no_restart
+            )
         self._actor_addr_cache.pop(actor_id, None)
         if no_restart:
             self._restartable_actor_inits.discard(actor_id)
             self._release_arg_pins(f"actor_init_{actor_id}")
+
+    def drop_actor_handle(self, actor_id: str) -> None:
+        """Owner handle GC. Routed through the lifecycle batcher so a
+        drop can never overtake its actor's still-queued registration at
+        the store (an unknown-actor drop is a silent no-op — the actor
+        would register right after and leak)."""
+        batcher = self._actor_batcher()
+        if batcher is not None:
+            batcher.enqueue_drop(actor_id)
+        else:
+            self.control.call_oneway(
+                "actor_handle_dropped", actor_id=actor_id
+            )
 
     def cancel_task(self, ref: ObjectRef, force: bool = False) -> None:
         """Cancel (reference core_worker.h Cancel): tasks not yet
@@ -3048,6 +3128,177 @@ class _DependencyResolver:
                     cb()
                 except Exception:  # noqa: BLE001
                     logger.exception("dependency-ready callback failed")
+
+
+class _ActorLifecycleBatcher:
+    """Client-side actor lifecycle coalescing (ISSUE 14).
+
+    ``create_actor`` / ``kill_actor`` enqueue and return immediately; one
+    flusher thread ships a single ``register_actors`` / ``kill_actors``
+    RPC per flush window (``actor_batch_flush_ms``), amortizing one RPC
+    round trip + one scheduler wakeup over the whole batch — the
+    10k-actor launch storm a Podracer-style job produces in one loop.
+
+    Semantics preserved:
+      * named creations wait synchronously (``wait_registered``) so a
+        name conflict still raises at ``.remote()`` time;
+      * per-record results — one bad spec fails only its own creation,
+        surfaced at ``wait_registered`` (first address resolution);
+      * intra-batch ordering — kills/drops for actors registered in the
+        SAME window land after the register RPC, kills for other actors
+        land before it (a named replacement may be waiting on the old
+        holder's death);
+      * retried batches are safe: the store treats duplicate register
+        (same actor_id) and duplicate kill as idempotent ok.
+    """
+
+    def __init__(self, worker: "CoreWorker"):
+        self._worker = worker
+        self._cv = threading.Condition(threading.Lock())
+        self._pending_reg: Dict[str, Dict[str, Any]] = {}
+        self._pending_kill: List[Tuple[str, bool]] = []
+        self._pending_drop: List[str] = []
+        self._inflight: set = set()  # actor_ids in a register RPC
+        self._errors: Dict[str, str] = {}  # actor_id -> per-record error
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def enqueue_register(self, spec: Dict[str, Any]) -> None:
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("worker is shutting down")
+            self._pending_reg[spec["actor_id"]] = spec
+            self._ensure_thread_locked()
+            self._cv.notify_all()
+
+    def enqueue_kill(self, actor_id: str, no_restart: bool) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._pending_kill.append((actor_id, no_restart))
+            self._ensure_thread_locked()
+            self._cv.notify_all()
+
+    def enqueue_drop(self, actor_id: str) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._pending_drop.append(actor_id)
+            self._ensure_thread_locked()
+            self._cv.notify_all()
+
+    def wait_registered(self, actor_id: str, timeout_s: float = 60.0) -> None:
+        """Block until the batch carrying this registration was acked,
+        re-raising its per-record error. Ids this batcher never saw (or
+        that already flushed clean) return immediately."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while actor_id in self._pending_reg or actor_id in self._inflight:
+                if time.monotonic() >= deadline:
+                    raise ActorUnavailableError(
+                        f"actor {actor_id} registration not acked in {timeout_s}s"
+                    )
+                self._cv.notify_all()  # wake the flusher: cut the window
+                self._cv.wait(0.5)
+            err = self._errors.pop(actor_id, None)
+        if err is not None:
+            raise ValueError(f"actor registration failed: {err}")
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Flush everything still queued and stop the thread."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="actor-lifecycle-batch", daemon=True
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not (self._pending_reg or self._pending_kill
+                           or self._pending_drop or self._closed):
+                    self._cv.wait(0.5)
+                if self._closed and not (
+                    self._pending_reg or self._pending_kill or self._pending_drop
+                ):
+                    return
+            flush_s = float(config.actor_batch_flush_ms) / 1000.0
+            if flush_s > 0 and not self._closed:
+                time.sleep(flush_s)  # accumulation window
+            with self._cv:
+                regs = list(self._pending_reg.values())
+                self._pending_reg.clear()
+                kills, self._pending_kill = self._pending_kill, []
+                drops, self._pending_drop = self._pending_drop, []
+                self._inflight.update(s["actor_id"] for s in regs)
+            try:
+                self._flush(regs, kills, drops)
+            except Exception:  # noqa: BLE001 — keep the flusher alive
+                logger.exception("actor lifecycle flush failed")
+                with self._cv:
+                    for s in regs:
+                        self._inflight.discard(s["actor_id"])
+                        self._errors.setdefault(
+                            s["actor_id"], "lifecycle flush failed"
+                        )
+            with self._cv:
+                self._cv.notify_all()
+
+    def _flush(self, regs: List[Dict[str, Any]],
+               kills: List[Tuple[str, bool]], drops: List[str]) -> None:
+        reg_ids = {s["actor_id"] for s in regs}
+        self._send_kills([k for k in kills if k[0] not in reg_ids])
+        if regs:
+            try:
+                res = self._worker.control.call(
+                    "register_actors", specs=regs, retryable=True,
+                    timeout_s=120.0,
+                )
+            except BaseException as e:  # noqa: BLE001 — whole batch failed
+                res = [
+                    {"actor_id": s["actor_id"], "ok": False,
+                     "error": f"{type(e).__name__}: {e}"}
+                    for s in regs
+                ]
+            with self._cv:
+                for r in res:
+                    if not r.get("ok"):
+                        self._errors[r.get("actor_id")] = (
+                            r.get("error") or "registration failed"
+                        )
+                for s in regs:
+                    self._inflight.discard(s["actor_id"])
+                self._cv.notify_all()
+        self._send_kills([k for k in kills if k[0] in reg_ids])
+        for actor_id in drops:
+            try:
+                self._worker.control.call_oneway(
+                    "actor_handle_dropped", actor_id=actor_id
+                )
+            except RpcError:
+                pass
+
+    def _send_kills(self, kills: List[Tuple[str, bool]]) -> None:
+        for flag in (True, False):
+            ids = [aid for aid, nr in kills if nr is flag]
+            if ids:
+                try:
+                    self._worker.control.call(
+                        "kill_actors", actor_ids=ids, no_restart=flag,
+                        retryable=True, timeout_s=120.0,
+                    )
+                except RpcError as e:
+                    logger.warning(
+                        "batched kill of %d actor(s) failed: %s", len(ids), e
+                    )
 
 
 class _ActorSender:

@@ -277,6 +277,19 @@ config.define("serve_disagg_timeout_s", 60.0)
 # dispatcher thread longer than this per call — clients re-issue slices
 # until their own deadline (tools/rtlint dispatcher-block pass).
 config.define("dispatch_wait_slice_s", 2.0)
+# Control-plane scale envelope (ISSUE 14). actor_batch_flush_ms: the
+# worker-side lifecycle batcher coalesces create/kill submissions for
+# this long, then ships ONE register_actors/kill_actors RPC (0 = legacy
+# one-RPC-per-actor path, also the bench A/B lever). wal_group_commit_ms:
+# the HA WAL buffers appends from concurrent dispatcher threads and
+# lands them as one buffered write (+ one fsync when ha_wal_fsync) per
+# window; every RPC reply still barriers on durability of its own ops,
+# so acked => in-WAL is unchanged (0 = per-op appends).
+config.define("actor_batch_flush_ms", 2.0)
+config.define("wal_group_commit_ms", 2.0)
+# Bounded fan-out for parallel actor teardown (exit/release RPCs to
+# workers and node agents during kill-drain).
+config.define("actor_kill_fanout", 16)
 
 # --- Per-host / per-process flags (dynamic) ----------------------------
 # Re-read from the environment on every access and EXCLUDED from

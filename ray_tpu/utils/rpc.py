@@ -438,6 +438,11 @@ class RpcServer:
         self._conns_lock = threading.Lock()
         self._accept_thread: Optional[threading.Thread] = None
         self.on_disconnect: Optional[Callable[[ClientConnection], None]] = None
+        # Optional hook run in the dispatch thread after a handler returns
+        # and before its reply is sent (skipped for one-way calls). The
+        # control store points this at the WAL group-commit barrier so an
+        # ack still implies durability under batched writes.
+        self.post_dispatch: Optional[Callable[[], None]] = None
 
     @property
     def address(self) -> str:
@@ -609,6 +614,19 @@ class RpcServer:
             ) if not isinstance(e, RemoteError) else e
         if req_id is None:  # one-way call
             return
+        if self.post_dispatch is not None:
+            # ack barrier (e.g. WAL group commit): runs after the handler
+            # released its locks but before the caller can observe the
+            # reply. A barrier failure must fail the ack — the op may not
+            # be durable.
+            try:
+                self.post_dispatch()
+            except Exception as e:  # noqa: BLE001
+                if ok:
+                    ok = False
+                    payload = RemoteError(
+                        f"{type(e).__name__}: {e}", traceback.format_exc()
+                    )
         try:
             _send_message(
                 conn.sock, ("resp", req_id, ok, payload), conn.send_lock,

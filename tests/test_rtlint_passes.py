@@ -128,6 +128,46 @@ def test_dispatcher_block_flags_helper_one_call_deep():
     assert "_park()" in live[0].message
 
 
+def test_dispatcher_block_flags_bulk_for_loop_deadline_wait():
+    # bulk-handler shape (ISSUE 14): iterating the batch with a
+    # deadline-bounded wait per record holds the dispatcher thread for
+    # batch_size x deadline
+    live, _ = _run("""
+        def rpc_kill_actors(self, conn, actor_ids, wait_s):
+            deadline = time.monotonic() + wait_s
+            for actor_id in actor_ids:
+                while self._alive(actor_id) and time.monotonic() < deadline:
+                    time.sleep(0.01)
+        def rpc_register_actors(self, conn, specs, wait_s):
+            deadline = time.monotonic() + wait_s
+            for spec in specs:
+                self._done[spec["actor_id"]].wait(deadline - time.monotonic())
+    """, "dispatcher-block", _DISPATCH_FILE)
+    assert len(live) >= 2
+    assert all("caller-supplied deadline" in f.message for f in live[:2])
+
+
+def test_dispatcher_block_flags_unbounded_future_result():
+    # fan-out-then-block: a bulk handler that parks on pool futures with
+    # no timeout holds the dispatcher for as long as the slowest agent
+    live, _ = _run("""
+        def rpc_kill_actors(self, conn, actor_ids):
+            futs = [self._pool.submit(self._kill_one, a) for a in actor_ids]
+            return [f.result() for f in futs]
+    """, "dispatcher-block", _DISPATCH_FILE)
+    assert len(live) == 1
+    assert ".result()" in live[0].message
+
+
+def test_dispatcher_block_bounded_future_result_is_clean():
+    live, _ = _run("""
+        def rpc_kill_actors(self, conn, actor_ids):
+            futs = [self._pool.submit(self._kill_one, a) for a in actor_ids]
+            return [f.result(timeout=10.0) for f in futs]
+    """, "dispatcher-block", _DISPATCH_FILE)
+    assert not live, [f.format() for f in live]
+
+
 def test_dispatcher_block_suppressed_with_reason():
     live, suppressed = _run("""
         def rpc_wait_thing(self, conn, wait_s):

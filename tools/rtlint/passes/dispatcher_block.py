@@ -16,11 +16,14 @@ Checked: ``rpc_*`` and ``_raw_*`` functions in ``control_store.py`` and
 ``node_agent.py``.  Flags:
 
 1. unbounded primitive waits: zero-arg ``.wait()`` / ``.join()`` /
-   ``.get()`` (or an explicit ``timeout=None``);
+   ``.get()`` / ``.result()`` (or an explicit ``timeout=None``) —
+   ``.result()`` covers futures a bulk handler fans out on a pool and
+   then blocks on;
 2. a wait loop run to a caller-supplied deadline: ``deadline =
    time.monotonic() + wait_s`` (``wait_s`` a parameter, not capped)
-   followed by a ``while`` that references the deadline and sleeps or
-   waits inside;
+   followed by a ``while`` — or a ``for`` (a bulk handler iterating its
+   batch with a per-record wait inside) — that references the deadline
+   and sleeps or waits inside;
 3. a condition/event wait whose timeout expression mentions an uncapped
    parameter directly (``cv.wait(wait_s)``);
 4. the same one call deep: passing an uncapped parameter or deadline to
@@ -158,11 +161,13 @@ def _is_wait_or_sleep(node: ast.AST) -> bool:
 def _deadline_wait_loops(
     fn: ast.AST, deadline_names: Set[str]
 ) -> List[Tuple[int, str]]:
-    """While loops that reference a deadline name and wait/sleep inside:
-    (lineno, deadline_name) pairs."""
+    """While/for loops that reference a deadline name and wait/sleep
+    inside: (lineno, deadline_name) pairs. ``for`` matters for bulk
+    handlers — iterating the batch with a deadline-bounded wait per
+    record multiplies the hold time by the batch size."""
     out: List[Tuple[int, str]] = []
     for node in ast.walk(fn):
-        if not isinstance(node, ast.While):
+        if not isinstance(node, (ast.While, ast.For)):
             continue
         refs = _names_in(node) & deadline_names
         if not refs:
@@ -199,7 +204,7 @@ def _unbounded_primitive_waits(fn: ast.AST) -> List[Tuple[int, str]]:
         if not (
             isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ("wait", "join", "get")
+            and node.func.attr in ("wait", "join", "get", "result")
         ):
             continue
         timeout_none = any(
@@ -209,7 +214,9 @@ def _unbounded_primitive_waits(fn: ast.AST) -> List[Tuple[int, str]]:
             for kw in node.keywords
         )
         if (not node.args and not node.keywords) or timeout_none:
-            # zero-arg .get() is queue-like (dict.get always takes a key)
+            # zero-arg .get() is queue-like (dict.get always takes a key);
+            # zero-arg .result() is a future a handler fanned out and is
+            # now blocking on with no bound
             out.append((node.lineno, node.func.attr))
     return out
 
