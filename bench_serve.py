@@ -1,0 +1,344 @@
+"""Standing serve load harness: open-loop Poisson load against the
+OpenAI front door, with client-vs-server latency cross-validation.
+
+Closed-loop load (N workers, each waiting for its response before
+sending the next) hides queueing collapse: when the server slows down,
+a closed loop slows its own arrival rate and the measured latency looks
+flat. This harness is **open-loop** — arrival times are drawn from a
+Poisson process (exponential inter-arrivals at ``--rate``) up front and
+requests launch on schedule regardless of completions, so queueing
+delay lands in the numbers instead of in the arrival process. Arrivals
+beyond ``--max-inflight`` concurrent SSE clients are counted as shed,
+never delayed.
+
+Each client streams ``POST /v1/completions`` (``stream: true``) over a
+raw ``http.client`` connection, timestamping every SSE event off the
+socket: TTFT = first token event, ITL = gaps between token events, e2e
+= request start → ``[DONE]``. Prompt lengths are heavy-tailed
+(lognormal, capped) — the byte-level tokenizer maps an ``"a"*n`` prompt
+to exactly n tokens, so the tail exercises the power-of-two prefill
+buckets the way mixed real traffic would.
+
+After the run the harness cross-validates the observability plane: the
+client-measured TTFT p95 must agree with the server-side
+histogram-interpolated p95 (``rt_serve_ttft_s`` bucket DELTAS over the
+measured window, interpolated by ``utils/metrics.hist_quantile`` — the
+same code path ``rt top`` and the alert engine use) within
+``max(p95 bucket span, 30% of the larger value, 10 ms)`` — bucket
+interpolation cannot resolve finer than the bucket it lands in.
+
+Every run appends one row to BENCH_SERVE.json.
+
+Run: python bench_serve.py --rate 30 --duration 20
+"""
+
+import argparse
+import http.client
+import json
+import math
+import os
+import random
+import sys
+import threading
+import time
+
+MODEL = "bench"
+DEPLOYMENT = "bench-llm"
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _sample_prompt_len(rng, median, sigma, cap):
+    """Lognormal prompt length: median * e^(sigma*N(0,1)), capped. The
+    tail (sigma=1 puts ~5% of prompts past 5x the median) is the point —
+    uniform prompts would never leave one prefill bucket."""
+    n = int(median * math.exp(sigma * rng.gauss(0.0, 1.0)))
+    return max(1, min(n, cap))
+
+
+def _stream_one(host, port, prompt_len, max_tokens, timeout_s):
+    """One SSE client: returns a record with ttft/itl/e2e or an error."""
+    body = json.dumps({
+        "model": MODEL, "prompt": "a" * prompt_len,
+        "max_tokens": max_tokens, "temperature": 0, "stream": True,
+    })
+    rec = {"ok": False, "tokens": 0, "itls": []}
+    t0 = time.perf_counter()
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("POST", "/v1/completions", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            resp.read()
+            rec["error"] = f"http {resp.status}"
+            return rec
+        ttft = None
+        last = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if not line.startswith(b"data: "):
+                continue  # SSE blank separator lines
+            now = time.perf_counter()
+            if line[6:].strip() == b"[DONE]":
+                break
+            if ttft is None:
+                ttft = now - t0
+            else:
+                rec["itls"].append(now - last)
+            last = now
+            rec["tokens"] += 1
+        rec["ok"] = ttft is not None
+        rec["ttft"] = ttft
+        rec["e2e"] = time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001 — every failure mode is data
+        rec["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        conn.close()
+    return rec
+
+
+def _hist_bucket_span(bounds, buckets, q):
+    """Width of the bucket the q-quantile falls in — the interpolation
+    error bound for the server-side percentile."""
+    total = sum(buckets)
+    if not total or not bounds:
+        return 0.0
+    rank = q * total
+    acc = 0.0
+    for i, b in enumerate(buckets[:len(bounds)]):
+        acc += b
+        if acc >= rank:
+            return bounds[i] - (bounds[i - 1] if i else 0.0)
+    return bounds[-1] - (bounds[-2] if len(bounds) > 1 else 0.0)
+
+
+def _sum_ttft_hist(mx):
+    """(bounds, buckets, count) of rt_serve_ttft_s summed across series."""
+    m = mx.get("rt_serve_ttft_s") or {}
+    bounds = list(m.get("boundaries") or ())
+    buckets = None
+    count = 0.0
+    for h in (m.get("series") or {}).values():
+        bk = list(h.get("buckets") or ())
+        if buckets is None:
+            buckets = [0.0] * max(len(bk), len(bounds) + 1)
+        for i, v in enumerate(bk):
+            buckets[i] += v
+        count += h.get("count", 0)
+    return bounds, (buckets or []), count
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=30.0,
+                    help="mean arrival rate, requests/s (Poisson)")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="load window, seconds")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--max-batch-size", type=int, default=4)
+    ap.add_argument("--max-tokens", type=int, default=16,
+                    help="tokens generated per request")
+    ap.add_argument("--prompt-median", type=int, default=32)
+    ap.add_argument("--prompt-sigma", type=float, default=1.0)
+    ap.add_argument("--prompt-cap", type=int, default=512)
+    ap.add_argument("--max-inflight", type=int, default=256,
+                    help="concurrent SSE clients; arrivals past this shed")
+    ap.add_argument("--timeout", type=float, default=60.0,
+                    help="per-request client timeout, seconds")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVE.json"))
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve, state
+    from ray_tpu.observability.history import hist_delta
+    from ray_tpu.serve import llm as serve_llm
+    from ray_tpu.utils.metrics import hist_quantile
+
+    rng = random.Random(args.seed)
+    ray_tpu.init(num_cpus=max(8, args.replicas * 2))
+    serve.start(http_port=0)
+    try:
+        serve_llm.deploy(
+            {MODEL: serve_llm.LLMConfig(
+                model_id="gpt2-tiny", max_batch_size=args.max_batch_size,
+            )},
+            name=DEPLOYMENT, num_replicas=args.replicas,
+            route_prefix="/v1",
+        )
+        deadline = time.monotonic() + 60
+        addrs = []
+        while time.monotonic() < deadline and not addrs:
+            addrs = serve.proxy_addresses()
+            time.sleep(0.2)
+        assert addrs, "no HTTP proxy came up"
+        host, port = addrs[0].rsplit(":", 1)
+        port = int(port)
+
+        # warm every prefill bucket the lognormal mix will hit, and every
+        # replica's decode path, before the measured window
+        for n in (8, args.prompt_median, args.prompt_median * 4):
+            for _ in range(args.replicas):
+                _stream_one(host, port, n, 4, args.timeout)
+
+        # ---- measured window: open-loop Poisson arrivals ----
+        arrivals = []
+        t = 0.0
+        while t < args.duration:
+            t += rng.expovariate(args.rate)
+            if t < args.duration:
+                arrivals.append(t)
+        mx0 = state.cluster_metrics()
+        b0, k0, c0 = _sum_ttft_hist(mx0)
+
+        results = []
+        results_lock = threading.Lock()
+        inflight = threading.Semaphore(args.max_inflight)
+        shed = 0
+        threads = []
+
+        def worker(prompt_len):
+            try:
+                rec = _stream_one(
+                    host, port, prompt_len, args.max_tokens, args.timeout
+                )
+            finally:
+                inflight.release()
+            with results_lock:
+                results.append(rec)
+
+        bench_t0 = time.perf_counter()
+        for at in arrivals:
+            delay = at - (time.perf_counter() - bench_t0)
+            if delay > 0:
+                time.sleep(delay)
+            if not inflight.acquire(blocking=False):
+                shed += 1  # open loop: never delay the arrival process
+                continue
+            th = threading.Thread(
+                target=worker,
+                args=(_sample_prompt_len(
+                    rng, args.prompt_median, args.prompt_sigma,
+                    args.prompt_cap,
+                ),),
+                daemon=True,
+            )
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=args.timeout + 30)
+        wall_s = time.perf_counter() - bench_t0
+
+        # ---- client-side rollup ----
+        ok = [r for r in results if r.get("ok")]
+        errors = [r for r in results if not r.get("ok")]
+        ttfts = sorted(r["ttft"] for r in ok)
+        e2es = sorted(r["e2e"] for r in ok)
+        itls = sorted(g for r in ok for g in r["itls"])
+        tokens = sum(r["tokens"] for r in ok)
+        client_p95 = _percentile(ttfts, 0.95)
+
+        # ---- server-side: TTFT histogram DELTAS over the window ----
+        mx1 = state.cluster_metrics()
+        b1, k1, c1 = _sum_ttft_hist(mx1)
+        _dc, _ds, dbuckets = hist_delta(
+            {"count": c0, "sum": 0.0, "buckets": k0},
+            {"count": c1, "sum": 0.0, "buckets": k1},
+        )
+        server_p95 = hist_quantile(b1, dbuckets, 0.95)
+        span = _hist_bucket_span(b1, dbuckets, 0.95)
+
+        assert ok, f"no request succeeded ({len(errors)} errors)"
+        assert client_p95 is not None and server_p95 is not None
+        tolerance = max(span, 0.30 * max(client_p95, server_p95), 0.010)
+        delta = abs(client_p95 - server_p95)
+        agree = delta <= tolerance
+
+        alerts_rep = state.alerts()
+        firing = [
+            a["name"] for a in alerts_rep.get("alerts", ())
+            if a.get("state") == "firing"
+        ]
+
+        row = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "rate_rps": args.rate,
+            "duration_s": args.duration,
+            "replicas": args.replicas,
+            "max_batch_size": args.max_batch_size,
+            "max_tokens": args.max_tokens,
+            "prompt": {"median": args.prompt_median,
+                       "sigma": args.prompt_sigma, "cap": args.prompt_cap},
+            "requests": {
+                "scheduled": len(arrivals), "ok": len(ok),
+                "errors": len(errors), "shed": shed,
+            },
+            "goodput_rps": round(len(ok) / wall_s, 2),
+            "tokens_per_s": round(tokens / wall_s, 1),
+            "client_ms": {
+                "ttft_p50": round(_percentile(ttfts, 0.50) * 1e3, 1),
+                "ttft_p95": round(client_p95 * 1e3, 1),
+                "ttft_p99": round(_percentile(ttfts, 0.99) * 1e3, 1),
+                "itl_p50": round((_percentile(itls, 0.50) or 0) * 1e3, 2),
+                "itl_p95": round((_percentile(itls, 0.95) or 0) * 1e3, 2),
+                "e2e_p50": round(_percentile(e2es, 0.50) * 1e3, 1),
+                "e2e_p95": round(_percentile(e2es, 0.95) * 1e3, 1),
+            },
+            "server_ms": {
+                "ttft_p95": round(server_p95 * 1e3, 1),
+                "p95_bucket_span": round(span * 1e3, 1),
+                "window_count": _dc,
+            },
+            "agreement": {
+                "delta_ms": round(delta * 1e3, 1),
+                "tolerance_ms": round(tolerance * 1e3, 1),
+                "ok": agree,
+            },
+            "alerts_firing": firing,
+        }
+        print(json.dumps(row, indent=2))
+
+        doc = {"schema": 1, "rows": []}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    doc = json.load(f)
+            except ValueError:
+                pass
+        doc.setdefault("rows", []).append(row)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+
+        if not agree:
+            print(
+                f"FAIL: client p95 TTFT {client_p95 * 1e3:.1f}ms vs server "
+                f"{server_p95 * 1e3:.1f}ms differs by {delta * 1e3:.1f}ms "
+                f"> tolerance {tolerance * 1e3:.1f}ms",
+                file=sys.stderr,
+            )
+            return 1
+        print(json.dumps({
+            "ok": True,
+            "goodput_rps": row["goodput_rps"],
+            "client_ttft_p95_ms": row["client_ms"]["ttft_p95"],
+            "server_ttft_p95_ms": row["server_ms"]["ttft_p95"],
+        }))
+        return 0
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

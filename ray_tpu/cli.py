@@ -29,30 +29,92 @@ def _fmt_table(rows: List[dict], columns: List[str]) -> str:
     return "\n".join(lines)
 
 
-def _hist_quantile(bounds, buckets, q) -> Optional[float]:
-    """Bucket-interpolated quantile from a merged histogram series.
-    None when bucket detail was dropped (divergent boundaries across
-    workers) or the series is empty."""
-    total = sum(buckets)
-    if not bounds or not total:
+# Single shared interpolation (utils/metrics.py): the renderer, state
+# rollups, history store, and alert engine must all agree on quantile
+# math.
+from ray_tpu.utils.metrics import hist_quantile as _hist_quantile  # noqa: E402
+
+_SPARK_GLYPHS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(vals: List[float], width: int = 12) -> str:
+    """Render the trailing ``width`` values as a unicode sparkline."""
+    vals = [v for v in vals if v is not None][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        _SPARK_GLYPHS[min(
+            int((v - lo) / span * (len(_SPARK_GLYPHS) - 1) + 0.5),
+            len(_SPARK_GLYPHS) - 1,
+        )]
+        for v in vals
+    )
+
+
+def _router_deps(mx: dict) -> List[str]:
+    m = mx.get("rt_serve_router_requests_total") or {}
+    deps = set()
+    for k in m.get("series", {}):
+        deps.add(dict(zip(m.get("tag_keys", ()), k)).get("deployment") or "?")
+    return sorted(deps)
+
+
+def _top_history(state_mod, addr, since: float, deps: List[str]):
+    """History-derived `rt top` view: per-deployment TTFT percentiles
+    over the trailing ``since`` window plus a QPS sparkline, from the
+    head's metrics-history store. None when the sampler is disabled."""
+    try:
+        root = state_mod.metrics_history(address=addr)
+    except Exception:  # noqa: BLE001 — older head / no handler
         return None
-    rank = q * total
-    cum = 0
-    lo = 0.0
-    for i, n in enumerate(buckets):
-        hi = bounds[i] if i < len(bounds) else bounds[-1]
-        if n and cum + n >= rank:
-            return lo + (hi - lo) * ((rank - cum) / n)
-        cum += n
-        lo = hi
-    return bounds[-1]
+    if not isinstance(root, dict) or not root.get("enabled"):
+        return None
+    out = {"window_s": since, "deployments": {}}
+    for dep in deps:
+        entry: dict = {}
+        try:
+            h = state_mod.metrics_history(
+                "rt_serve_ttft_s", tags={"deployment": dep},
+                window_s=since, address=addr,
+            )
+            pts = [p for p in h.get("points", ()) if p.get("buckets")]
+            if pts and h.get("boundaries"):
+                buckets = [0.0] * max(len(p["buckets"]) for p in pts)
+                for p in pts:
+                    for i, b in enumerate(p["buckets"]):
+                        buckets[i] += b
+                entry["ttft_p50_s"] = _hist_quantile(
+                    h["boundaries"], buckets, 0.5
+                )
+                entry["ttft_p95_s"] = _hist_quantile(
+                    h["boundaries"], buckets, 0.95
+                )
+            q = state_mod.metrics_history(
+                "rt_serve_router_requests_total", tags={"deployment": dep},
+                window_s=since, address=addr,
+            )
+            rates = [p.get("rate", 0.0) for p in q.get("points", ())]
+            if rates:
+                entry["qps_points"] = rates
+                entry["qps_avg"] = sum(rates) / len(rates)
+        except Exception:  # noqa: BLE001 — a hiccup must not kill a frame
+            pass
+        if entry:
+            out["deployments"][dep] = entry
+    return out
 
 
-def _render_top(mx: dict, reqs: dict, qps: Optional[dict]) -> str:
+def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
+                alerts_rep: Optional[dict] = None,
+                hist: Optional[dict] = None) -> str:
     """One `rt top` frame from a state.cluster_metrics() aggregate and a
     state.request_summary() rollup. ``qps`` maps deployment -> req/s
     computed by the caller from successive router-counter frames (None
-    on the first frame / --once)."""
+    on the first frame / --once). ``alerts_rep`` / ``hist`` (state.alerts
+    and the metrics-history view) add the FIRING banner and the windowed
+    sparkline/percentile columns when the head-side sampler is on."""
 
     def metric(name: str) -> dict:
         return mx.get(name) or {"series": {}, "tag_keys": ()}
@@ -94,6 +156,17 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict]) -> str:
         return f"{v * 1e3:.1f}" if v is not None else "-"
 
     out = []
+    firing = [
+        a for a in (alerts_rep or {}).get("alerts", ())
+        if a.get("state") == "firing"
+    ]
+    if firing:
+        out.append("!! FIRING: " + ", ".join(
+            f"{a['name']}"
+            + (f" ({a['value']:.3g})" if a.get("value") is not None else "")
+            for a in firing
+        ))
+        out.append("")
     out.append(
         f"sched queue {scalar_sum('rt_sched_queue_depth'):g}  |  "
         f"object store {int(scalar_sum('rt_object_store_used_bytes')):,} B  |  "
@@ -139,14 +212,24 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict]) -> str:
         r["qps"] = (
             f"{qps.get(dep, 0.0):.1f}" if qps is not None else "-"
         )
+    columns = ["deployment", "reqs", "qps", "ttft_p50_ms", "ttft_p95_ms",
+               "itl_p50_ms", "tokens", "kv_slots", "queued", "batch_fill",
+               "cache_hit"]
+    if hist is not None:
+        # windowed view from the history store: TTFT p95 over the last
+        # --since seconds (not since boot) + a QPS sparkline
+        win = hist.get("window_s", 60)
+        for dep, h in hist.get("deployments", {}).items():
+            r = row(dep)
+            r[f"ttft_p95_{win:g}s_ms"] = ms(h.get("ttft_p95_s"))
+            r["qps_hist"] = _sparkline(h.get("qps_points") or [])
+        columns[columns.index("ttft_p95_ms") + 1:
+                columns.index("ttft_p95_ms") + 1] = [
+            f"ttft_p95_{hist.get('window_s', 60):g}s_ms", "qps_hist",
+        ]
     out.append("")
     out.append("serve")
-    out.append(_fmt_table(
-        [rows[d] for d in sorted(rows)],
-        ["deployment", "reqs", "qps", "ttft_p50_ms", "ttft_p95_ms",
-         "itl_p50_ms", "tokens", "kv_slots", "queued", "batch_fill",
-         "cache_hit"],
-    ))
+    out.append(_fmt_table([rows[d] for d in sorted(rows)], columns))
 
     # -- request summary: e2e / queue / exec percentiles per deployment --
     rrows = []
@@ -259,6 +342,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="per-task queue-wait / exec latency percentiles",
     )
     sub.add_parser("metrics", help="aggregated metrics (Prometheus text)")
+    sub.add_parser(
+        "alerts",
+        help="alert-rule states (SLO burn-rate / threshold rules over "
+             "the head's metrics history); exits 2 while any rule fires",
+    )
     top = sub.add_parser(
         "top",
         help="live serving / pipeline SLO view (QPS, TTFT, KV occupancy, "
@@ -269,6 +357,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     top.add_argument("--once", action="store_true",
                      help="render a single frame and exit (no screen "
                           "clearing; scriptable)")
+    top.add_argument("--since", type=float, default=60.0,
+                     help="trailing window (s) for the history-derived "
+                          "columns (windowed TTFT p95, QPS sparkline)")
     dash = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     dash.add_argument("--port", type=int, default=8265)
     dash.add_argument(
@@ -485,21 +576,68 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         print(metrics_mod.prometheus_text(state.cluster_metrics(addr)), end="")
         return 0
+    if args.cmd == "alerts":
+        from ray_tpu.utils.rpc import RemoteError
+
+        try:
+            rep = state.alerts(addr)
+        except RemoteError:
+            rep = {"enabled": False, "alerts": []}
+        if args.as_json:
+            print(json.dumps(rep, indent=2, default=str))
+        elif not rep.get("enabled"):
+            print("alerting disabled (RT_METRICS_SAMPLE_INTERVAL_S=0, "
+                  "RT_ALERTS_ENABLED=0, or observability off)")
+        else:
+            rows = []
+            for a in rep["alerts"]:
+                rows.append({
+                    "rule": a["name"],
+                    "state": a["state"].upper()
+                    if a["state"] == "firing" else a["state"],
+                    "severity": a["severity"],
+                    "metric": a["metric"],
+                    "value": (
+                        f"{a['value']:.4g}" if a.get("value") is not None
+                        else "-"
+                    ),
+                    "since_s": (
+                        f"{a['since_s']:.0f}" if a.get("since_s") is not None
+                        else "-"
+                    ),
+                })
+            print(_fmt_table(rows, [
+                "rule", "state", "severity", "metric", "value", "since_s",
+            ]))
+        # scriptable: non-zero while anything fires (cron/CI gating)
+        return 2 if any(
+            a.get("state") == "firing" for a in rep.get("alerts", ())
+        ) else 0
     if args.cmd == "top":
         import time as _time
+
+        from ray_tpu.observability.history import counter_delta
+        from ray_tpu.utils.rpc import RemoteError
 
         def frame(qps):
             mx = state.cluster_metrics(addr)
             reqs = state.request_summary(addr)
+            try:
+                alerts_rep = state.alerts(addr)
+            except (RemoteError, RuntimeError):
+                alerts_rep = {"enabled": False, "alerts": []}
+            hist = _top_history(state, addr, args.since, _router_deps(mx))
             if args.as_json:
                 return mx, json.dumps(
                     {"metrics": {
                         name: dict(m, series={
                             ",".join(k): v for k, v in m["series"].items()
                         }) for name, m in mx.items()
-                    }, "requests": reqs}, indent=2, default=str,
+                    }, "requests": reqs, "alerts": alerts_rep,
+                        "history": hist}, indent=2, default=str,
                 )
-            return mx, _render_top(mx, reqs, qps)
+            return mx, _render_top(mx, reqs, qps, alerts_rep=alerts_rep,
+                                   hist=hist)
 
         if args.once:
             print(frame(None)[1])
@@ -510,7 +648,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             while True:
                 mx, text = frame(qps)
-                # QPS = router-counter delta over the frame gap
+                # QPS = reset-aware router-counter delta over the frame
+                # gap (a restarted replica's counter going backwards
+                # counts as a fresh start, not a zero-QPS frame)
                 m = mx.get("rt_serve_router_requests_total") or {}
                 cur = {}
                 for k, v in m.get("series", {}).items():
@@ -521,7 +661,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 now = _time.monotonic()
                 if prev is not None and now > prev_t:
                     qps = {
-                        d: max(v - prev.get(d, 0.0), 0.0) / (now - prev_t)
+                        d: counter_delta(prev.get(d), v) / (now - prev_t)
                         for d, v in cur.items()
                     }
                 prev, prev_t = cur, now

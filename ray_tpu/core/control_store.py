@@ -151,6 +151,13 @@ class ControlStore:
         self._workers = ClientPool("cs->worker")
         self._stopped = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
+        # Metrics history + alert plane (ISSUE 15): built in start()
+        # when sampling is enabled (metrics_sample_interval_s > 0 and
+        # observability on); None otherwise so the RPC handlers report
+        # "disabled" instead of serving empty stores.
+        self._history = None
+        self._alert_engine = None
+        self._sampler = None
 
         # Scheduling queue (reference GcsActorScheduler/PG scheduler run
         # on the GCS io-service, not a thread per entity): ONE dispatcher
@@ -195,10 +202,37 @@ class ControlStore:
         threading.Thread(
             target=self._sched_loop, name="cs-scheduler", daemon=True
         ).start()
+        self._start_observability()
         if self._recovering:
             threading.Thread(
                 target=self._reconcile_loop, name="cs-reconcile", daemon=True
             ).start()
+
+    def _start_observability(self) -> None:
+        """Start the metrics-history sampler (+ alert engine) on this
+        head. interval<=0 or observability_enabled=0 disables the whole
+        plane — no store, no thread, no per-tick scrape cost."""
+        interval = float(config.metrics_sample_interval_s)
+        if interval <= 0 or not bool(config.observability_enabled):
+            return
+        from ray_tpu.observability import alerts as alerts_mod
+        from ray_tpu.observability import history as history_mod
+
+        self._history = history_mod.MetricsHistory(
+            base_step_s=interval,
+            max_series=int(config.metrics_history_max_series),
+        )
+        on_tick = None
+        if bool(config.alerts_enabled):
+            self._alert_engine = alerts_mod.AlertEngine(
+                alerts_mod.default_rules(), self._history
+            )
+            on_tick = self._alert_engine.evaluate
+        self._sampler = history_mod.HistorySampler(
+            self._history, self.address, self._stopped, interval,
+            on_tick=on_tick,
+        )
+        self._sampler.start()
 
     def stop(self) -> None:
         self._stopped.set()
@@ -911,6 +945,30 @@ class ControlStore:
             "metrics": metrics_mod.snapshot_all(),
         }
 
+    def rpc_metrics_history(self, conn, name: Optional[str] = None,
+                            tags: Optional[Dict[str, str]] = None,
+                            window_s: Optional[float] = None,
+                            step_s: Optional[float] = None):
+        """Query the head-side metrics history (observability/history.py).
+        name=None returns the store inventory + sampler stats; with a
+        name, aggregated points for that metric (tags filter, window,
+        requested resolution)."""
+        h = self._history
+        if h is None:
+            return {"enabled": False}
+        if name is None:
+            return {"enabled": True, **h.stats()}
+        out = h.query(name, tags=tags, window_s=window_s, step_s=step_s)
+        out["enabled"] = True
+        return out
+
+    def rpc_alerts(self, conn):
+        """Current alert-rule states (observability/alerts.py)."""
+        eng = self._alert_engine
+        if eng is None:
+            return {"enabled": False, "alerts": []}
+        return {"enabled": True, "alerts": eng.describe()}
+
     def _public_node(self, node_id: str) -> Dict[str, Any]:
         n = self._nodes[node_id]
         return {
@@ -934,6 +992,11 @@ class ControlStore:
                 for nid, n in self._nodes.items():
                     if n["alive"] and now - n["last_heartbeat"] > config.health_check_timeout_s:
                         dead.append(nid)
+                n_dead = sum(
+                    1 for n in self._nodes.values() if not n["alive"]
+                ) + len(dead)
+            if core_metrics.ENABLED:
+                core_metrics.cluster_nodes_dead.set(float(n_dead))
             for nid in dead:
                 logger.warning("node %s missed heartbeats; marking dead", nid[:8])
                 self._mark_node_dead(nid, "heartbeat timeout")

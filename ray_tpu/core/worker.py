@@ -2622,8 +2622,21 @@ class CoreWorker:
                 core_metrics.task_events_dropped.inc()
         ring.append(evt)
 
-    def rpc_get_task_events(self, conn, clear: bool = False):
+    def rpc_get_task_events(self, conn, clear: bool = False,
+                            types: Optional[List[str]] = None):
+        """Drain/peek this worker's event ring. ``types`` filters
+        server-side by the events' "type" key — the metrics-history
+        sampler polls request spans every second, and shipping a full
+        10k-event ring per worker per tick (mostly lifecycle/exec
+        events under actor-heavy load) would make the sampler the
+        biggest RPC client in the cluster."""
+        # list() first: one atomic C-level copy under the GIL — a python
+        # -level comprehension over the live deque would race concurrent
+        # appends (RuntimeError: deque mutated during iteration)
         events = list(self._task_events)
+        if types is not None:
+            want = set(types)
+            events = [e for e in events if e.get("type") in want]
         dropped = self._task_events_dropped
         if clear:
             # window semantics: clearing starts a fresh window, so the

@@ -8,7 +8,9 @@ same data to programmatic consumers.
 
 Endpoints: /           HTML summary (auto-refresh)
            /api/status /api/nodes /api/actors /api/jobs /api/workers
-           /api/placement_groups /api/timeline /metrics (Prometheus text)
+           /api/placement_groups /api/timeline /api/alerts
+           /api/metrics/history?name=&window_s=&step_s=&tags={...}
+           /metrics (Prometheus text)
 """
 
 from __future__ import annotations
@@ -18,6 +20,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qsl, urlparse
 
 from ray_tpu import state
 from ray_tpu.utils import metrics as metrics_mod
@@ -38,7 +41,8 @@ _PAGE = """<!doctype html>
 <h2>jobs</h2>{jobs}
 <p>APIs: /api/status /api/nodes /api/actors /api/jobs /api/workers
 /api/placement_groups /api/timeline /api/task_summary
-/api/request_summary /metrics</p>
+/api/request_summary /api/alerts
+/api/metrics/history?name=&amp;window_s=&amp;step_s=&amp;tags= /metrics</p>
 </body></html>"""
 
 
@@ -132,6 +136,11 @@ class Dashboard:
 
     def _route(self, path: str):
         addr = self.control_address
+        # split the query string: /api/metrics/history takes parameters,
+        # and exact-path matching must not break on "?…" suffixes
+        parsed = urlparse(path)
+        path = parsed.path
+        qs = dict(parse_qsl(parsed.query))
         apis = {
             "/api/status": lambda: state.cluster_status(addr),
             "/api/nodes": lambda: state.list_nodes(addr),
@@ -142,6 +151,14 @@ class Dashboard:
             "/api/timeline": lambda: state.timeline(addr),
             "/api/task_summary": lambda: state.task_summary(addr),
             "/api/request_summary": lambda: state.request_summary(addr),
+            "/api/alerts": lambda: state.alerts(addr),
+            "/api/metrics/history": lambda: state.metrics_history(
+                name=qs.get("name"),
+                tags=json.loads(qs["tags"]) if qs.get("tags") else None,
+                window_s=float(qs["window_s"]) if qs.get("window_s") else None,
+                step_s=float(qs["step_s"]) if qs.get("step_s") else None,
+                address=addr,
+            ),
         }
         if path in apis:
             return (

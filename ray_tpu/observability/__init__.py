@@ -3,8 +3,8 @@ metrics the reference exports through OpenCensus → dashboard-agent →
 Prometheus, plus task lifecycle state tracking for `ray timeline` and
 the state API).
 
-Two subsystems, two kill switches, both read ONCE into module-level
-flags so a disabled hot path pays a single attribute check:
+Subsystems and their kill switches (flags read ONCE into module-level
+attributes so a disabled hot path pays a single attribute check):
 
 - ``core_metrics`` — built-in Counter/Gauge/Histogram series wired into
   the scheduler, lease, object-store, RPC, and serve hot paths.
@@ -13,6 +13,18 @@ flags so a disabled hot path pays a single attribute check:
   dispatched on the owner; start/end execution slices on the executor)
   feeding ``state.timeline()`` flow events and ``state.task_summary()``.
   Disabled with ``RT_TRACE_EVENTS=0``.
+- ``history`` — head-side sampler retaining every scraped metric in
+  multi-resolution ring buffers (windowed percentiles, ``rt top``
+  sparklines, ``state.metrics_history()``). Disabled with
+  ``RT_METRICS_SAMPLE_INTERVAL_S=0`` (or observability off).
+- ``alerts`` — threshold-for-duration + two-window SLO burn-rate rules
+  evaluated over the history store on every sampler tick, surfaced via
+  ``state.alerts()`` / ``rt alerts`` / ``/api/alerts``. Disabled with
+  ``RT_ALERTS_ENABLED=0`` (or whenever the sampler is off).
+
+``history`` and ``alerts`` are NOT imported here: they run only on the
+head and are imported by the control store at start, keeping worker
+import cost flat.
 """
 
 from ray_tpu.observability import core_metrics, tracing  # noqa: F401

@@ -232,6 +232,20 @@ def _build() -> dict:
             "task lifecycle/execution events evicted from the bounded "
             "per-worker ring buffer",
         ),
+        # -- cluster health (core/control_store.py health loop) --
+        "cluster_nodes_dead": Gauge(
+            "rt_cluster_nodes_dead",
+            "nodes currently marked dead by the head's heartbeat health "
+            "loop (feeds the node_heartbeat_missed alert rule)",
+        ),
+        # total KV capacity next to rt_serve_kv_slots_occupied so the
+        # occupancy RATIO is computable by the alert engine without
+        # knowing every deployment's max_batch_size
+        "serve_kv_slots_total": Gauge(
+            "rt_serve_kv_slots_total",
+            "KV-cache slot capacity (max_batch_size) per engine process",
+            tag_keys=("deployment", "node"),
+        ),
     }
 
 

@@ -294,6 +294,9 @@ class LLMServer:
             )
             ntags = {"deployment": dep, "node": self._node_tag}
             core_metrics.serve_kv_slots_occupied.set(occupancy, tags=ntags)
+            core_metrics.serve_kv_slots_total.set(
+                self.cfg.max_batch_size, tags=ntags
+            )
             core_metrics.serve_queued_requests.set(queued, tags=ntags)
 
     # -- KV engine (continuous batching over cache slots) ---------------

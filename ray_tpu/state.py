@@ -175,15 +175,20 @@ def _worker_addresses(
 
 def _collect_task_events(
     address: Optional[str],
+    types: Optional[List[str]] = None,
 ) -> Tuple[List[Dict[str, Any]], int]:
     """Gather every worker's event ring. Returns (events, dropped_total)
     — dropped counts ring evictions, so a truncated timeline is
-    detectable instead of silently missing its head."""
+    detectable instead of silently missing its head. ``types`` filters
+    worker-side (rpc_get_task_events), so periodic consumers (the
+    metrics-history sampler) don't ship full rings every tick."""
     events: List[Dict[str, Any]] = []
     dropped = 0
     for addr in _worker_addresses(address):
         try:
-            reply = _pool.get(addr).call("get_task_events", timeout_s=10.0)
+            reply = _pool.get(addr).call(
+                "get_task_events", types=types, timeout_s=10.0
+            )
         except RpcConnectionError:
             _pool.drop(addr)
             continue
@@ -266,6 +271,24 @@ def timeline(address: Optional[str] = None,
                 "pid": e.get("worker") or e.get("pid", 0),
                 "tid": e.get("pid", 0),
                 "args": {"nbytes": e.get("nbytes", 0)},
+            })
+            continue
+        if etype == "alert":
+            # alert transitions render as global instants so a FIRING
+            # marker lines up against the request spans that caused it
+            trace.append({
+                "name": f"alert:{e.get('rule', '?')}:{e.get('state', '?')}",
+                "cat": "alert",
+                "ph": "i",
+                "s": "g",
+                "ts": e["ts_us"],
+                "pid": e.get("worker") or e.get("pid", 0),
+                "tid": e.get("pid", 0),
+                "args": {
+                    k: e[k]
+                    for k in ("rule", "state", "metric", "severity", "value")
+                    if e.get(k) is not None
+                },
             })
             continue
         if etype == "lifecycle":
@@ -420,7 +443,7 @@ def request_summary(address: Optional[str] = None) -> Dict[str, Any]:
     ttft_cold_s), and disaggregated deployments contribute prefill_s /
     transfer_s legs, so a hot-vs-cold or remote-prefill regression is
     visible without raw span spelunking."""
-    events, dropped = _collect_task_events(address)
+    events, dropped = _collect_task_events(address, types=["request"])
     per_dep: Dict[str, Dict[str, List[float]]] = {}
     for e in events:
         if e.get("type") != "request":
@@ -646,6 +669,36 @@ def merge_metric_snapshots(
     for snap in snapshots:
         _merge_snapshot_into(merged, snap)
     return merged
+
+
+def metrics_history(
+    name: Optional[str] = None,
+    tags: Optional[Dict[str, str]] = None,
+    window_s: Optional[float] = None,
+    step_s: Optional[float] = None,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Query the head's retained metric time series
+    (observability/history.py). ``name=None`` returns the store
+    inventory + sampler stats ({"enabled": False} when the sampler is
+    off). With a name: aggregated ring points — gauges as
+    ``{"ts","value"}``, counters as reset-aware ``{"ts","delta","rate"}``,
+    histograms as per-window bucket deltas — at the finest resolution
+    tier covering ``window_s`` (or the tier matching ``step_s``)."""
+    return _with_control(address, lambda c: c.call(
+        "metrics_history", name=name, tags=tags, window_s=window_s,
+        step_s=step_s, timeout_s=10.0,
+    ))
+
+
+def alerts(address: Optional[str] = None) -> Dict[str, Any]:
+    """Current alert-rule states from the head's alert engine
+    (observability/alerts.py): one entry per rule with its definition,
+    state (ok/pending/firing), last evaluated value, and how long it has
+    been in that state."""
+    return _with_control(
+        address, lambda c: c.call("alerts", timeout_s=10.0)
+    )
 
 
 def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:

@@ -290,6 +290,34 @@ config.define("wal_group_commit_ms", 2.0)
 # Bounded fan-out for parallel actor teardown (exit/release RPCs to
 # workers and node agents during kill-drain).
 config.define("actor_kill_fanout", 16)
+# Metrics history + alerting plane (ISSUE 15, observability/history.py +
+# alerts.py). The head samples state.cluster_metrics()+request_summary()
+# every metrics_sample_interval_s into multi-resolution ring buffers
+# (0 disables the sampler AND the alert engine; observability_enabled=0
+# also disables both). metrics_history_max_series caps distinct
+# (metric, tags) series retained — overflow series are dropped and
+# counted, bounding head memory.
+config.define("metrics_sample_interval_s", 1.0)
+config.define("metrics_history_max_series", 2048)
+# Alert engine: alerts_enabled gates rule evaluation on the sampler
+# tick. The default rule pack reads the knobs below; extra rules ship as
+# a JSON list of rule dicts in alerts_rules_extra.
+config.define("alerts_enabled", True)
+# TTFT SLO burn-rate rule: target latency, allowed bad-event fraction
+# (error budget), the two burn windows, and the burn multiple that
+# trips the rule on BOTH windows.
+config.define("alerts_ttft_target_s", 2.0)
+config.define("alerts_ttft_budget", 0.05)
+config.define("alerts_burn_short_s", 60.0)
+config.define("alerts_burn_long_s", 300.0)
+config.define("alerts_burn_factor", 1.0)
+# Threshold rules: sustained router/engine queue depth, KV-slot
+# occupancy ratio (occupied/total), and the for-duration both must hold
+# before firing.
+config.define("alerts_queue_depth_max", 64.0)
+config.define("alerts_kv_occupancy_frac", 0.95)
+config.define("alerts_for_s", 30.0)
+config.define("alerts_rules_extra", "")
 
 # --- Per-host / per-process flags (dynamic) ----------------------------
 # Re-read from the environment on every access and EXCLUDED from

@@ -167,6 +167,65 @@ class Histogram(_Metric):
             }
 
 
+def hist_quantile(
+    bounds: Sequence[float],
+    buckets: Sequence[float],
+    q: float,
+) -> Optional[float]:
+    """Bucket-interpolated quantile from a (merged) histogram series:
+    linear interpolation within the bucket holding the rank, Prometheus
+    ``histogram_quantile`` style. None when bucket detail was dropped
+    (divergent boundaries across workers) or the series is empty.
+
+    The single shared implementation — state rollups, the ``rt top``
+    renderer, the metrics-history store, and the alert engine all
+    interpolate identically, so a client-vs-server percentile
+    comparison (bench_serve.py) never diverges on interpolation math.
+    """
+    total = sum(buckets)
+    if not bounds or not total:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, n in enumerate(buckets):
+        hi = bounds[i] if i < len(bounds) else bounds[-1]
+        if n and cum + n >= rank:
+            return lo + (hi - lo) * ((rank - cum) / n)
+        cum += n
+        lo = hi
+    return bounds[-1]
+
+
+def hist_fraction_above(
+    bounds: Sequence[float],
+    buckets: Sequence[float],
+    threshold: float,
+) -> Optional[float]:
+    """Fraction of observations above ``threshold``, interpolated within
+    the bucket the threshold falls in (the SLO burn-rate numerator:
+    "what share of requests exceeded the target"). None on an empty
+    series or dropped bucket detail."""
+    total = sum(buckets)
+    if not bounds or not total:
+        return None
+    above = 0.0
+    lo = 0.0
+    for i, n in enumerate(buckets):
+        hi = bounds[i] if i < len(bounds) else float("inf")
+        if threshold <= lo:
+            above += n
+        elif threshold < hi and hi != float("inf"):
+            # threshold splits this bucket: assume uniform density
+            above += n * (hi - threshold) / (hi - lo)
+        elif threshold < hi:
+            # overflow bucket has no upper edge: no interpolation basis,
+            # count the whole bucket as above (pessimistic)
+            above += n
+        lo = hi
+    return min(above / total, 1.0)
+
+
 def snapshot_all() -> Dict[str, Dict]:
     with _lock:
         metrics = list(_registry.values())
