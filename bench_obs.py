@@ -17,6 +17,13 @@ cluster (driver, daemons, and spawned workers all read them at import):
                         the history/alerting plane off the serving hot
                         path, and reports the sampler's steady-state
                         duty cycle (scrape time / interval, must be <1%)
+  serve_stream_profiled streaming decode with the CONTINUOUS sampling
+                        profiler on at its documented default rate
+                        (RT_PROFILER_HZ=19) vs observability off — the
+                        off mode proves the kill switch beats the hz
+                        flag (no rt-prof thread), the on mode pins the
+                        sampler's measured duty cycle (stack-walk time /
+                        wall time) under 1%
 
 Also microbenchmarks the DISABLED guard itself (the single module-flag
 check every instrumented site pays when observability is off) and
@@ -51,7 +58,12 @@ GUARD_CHECKS_PER_UNIT = {
     "pipeline_step_1f1b": 96,
     "collective_allreduce": 8,
     "serve_stream_sampled": 8,
+    "serve_stream_profiled": 8,
 }
+
+# Continuous-profiler rate the profiled leg pins its <1% duty-cycle
+# contract at (the README's suggested always-on rate).
+PROFILED_LEG_HZ = 19
 
 
 def _measure_batch40() -> float:
@@ -289,12 +301,83 @@ def _measure_serve_sampled() -> float:
     return best
 
 
+def _measure_serve_profiled() -> float:
+    """Streaming decode with the continuous sampling profiler running in
+    the driver at the default always-on rate. The off mode
+    (RT_OBSERVABILITY_ENABLED=0, RT_PROFILER_HZ still set) must start
+    NO rt-prof thread — the kill switch wins; the on mode reports the
+    sampler's measured duty cycle (stack-walk busy time / wall time),
+    which the parent asserts is <1%. Returns tokens/s."""
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu.observability import profiler
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        plane_on = os.environ.get("RT_OBSERVABILITY_ENABLED", "1") != "0"
+        names = [t.name for t in threading.enumerate()]
+        if plane_on:
+            assert profiler.SAMPLER_THREAD_NAME in names, (
+                "continuous sampler thread missing with RT_PROFILER_HZ set"
+            )
+        else:
+            assert profiler.SAMPLER_THREAD_NAME not in names, (
+                "rt-prof thread must not exist with the plane disabled, "
+                "even with RT_PROFILER_HZ set"
+            )
+            assert profiler.continuous_status() == {
+                "running": False, "hz": 0.0,
+            }
+        srv = LLMServer(LLMConfig(model_id="gpt2-tiny", max_batch_size=4))
+
+        def stream_one(n_new: int) -> int:
+            toks = 0
+            for _ in srv({
+                "prompt_tokens": [1, 2, 3], "max_new_tokens": n_new,
+                "stream": True,
+            }):
+                toks += 1
+            return toks
+
+        stream_one(8)  # warm: jit compile prefill/decode
+        # run long enough for many sampler ticks so busy/wall is a
+        # steady-state duty cycle, not a cold-start sample
+        best = 0.0
+        deadline = time.time() + 4.5
+        while time.time() < deadline:
+            t0 = time.perf_counter()
+            toks = sum(stream_one(48) for _ in range(2))
+            dt = time.perf_counter() - t0
+            best = max(best, toks / dt)
+        srv._stop.set()
+        if plane_on:
+            st = profiler.continuous_status()
+            assert st.get("running"), "sampler died mid-benchmark"
+            print(json.dumps({
+                "metric": "profiler_duty_pct",
+                "value": round(st.get("duty_pct", 0.0), 4), "unit": "%",
+            }), flush=True)
+            print(json.dumps({
+                "metric": "profiler_samples",
+                "value": int(st.get("samples", 0)), "unit": "samples",
+            }), flush=True)
+    finally:
+        ray_tpu.shutdown()
+    return best
+
+
 BENCHES = {
     "tasks_async_batch40": (_measure_batch40, "tasks/s"),
     "serve_stream_tokens": (_measure_engine_stream, "tokens/s"),
     "pipeline_step_1f1b": (_measure_pipeline_step, "steps/s"),
     "collective_allreduce": (_measure_collective_allreduce, "ops/s"),
     "serve_stream_sampled": (_measure_serve_sampled, "tokens/s"),
+    "serve_stream_profiled": (_measure_serve_profiled, "tokens/s"),
 }
 
 
@@ -312,6 +395,12 @@ def _run_mode(mode: str, bench: str):
         env["RT_METRICS_SAMPLE_INTERVAL_S"] = "0"
     else:
         env.pop("RT_METRICS_SAMPLE_INTERVAL_S", None)
+    if bench == "serve_stream_profiled":
+        # the hz flag is set in BOTH modes: off proves the kill switch
+        # beats it (no rt-prof thread), on pins its duty cycle
+        env["RT_PROFILER_HZ"] = str(PROFILED_LEG_HZ)
+    else:
+        env.pop("RT_PROFILER_HZ", None)
     env.setdefault("JAX_PLATFORMS", "cpu")
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__),
@@ -387,6 +476,7 @@ def main() -> int:
 
     offs = {}
     sampler_duty_pct = None
+    profiler_duty_pct = None
     for bench, (_fn, unit) in BENCHES.items():
         off, _ = _run_mode("off", bench)
         on, extras = _run_mode("on", bench)
@@ -404,6 +494,14 @@ def main() -> int:
             record(
                 "sampler_ticks",
                 extras.get("sampler_ticks", {}).get("value", 0), "ticks",
+            )
+        if "profiler_duty_pct" in extras:
+            profiler_duty_pct = float(extras["profiler_duty_pct"]["value"])
+            record("profiler_duty_pct", profiler_duty_pct, "%")
+            record(
+                "profiler_samples",
+                extras.get("profiler_samples", {}).get("value", 0),
+                "samples",
             )
 
     guard_ns = _guard_cost_ns()
@@ -431,6 +529,17 @@ def main() -> int:
         failures.append(
             f"sampler duty cycle {sampler_duty_pct:.3f}% >= 1% of the "
             f"sample interval"
+        )
+    # third contract: the continuous sampling profiler at its default
+    # always-on rate stays under 1% of one core (busy / wall time)
+    if profiler_duty_pct is None:
+        failures.append(
+            "serve_stream_profiled never reported profiler duty"
+        )
+    elif profiler_duty_pct >= 1.0:
+        failures.append(
+            f"continuous profiler duty cycle {profiler_duty_pct:.3f}% "
+            f">= 1% at {PROFILED_LEG_HZ} Hz"
         )
     # legacy aliases kept for dashboards pinned to the original keys
     results["tracing_on_overhead_pct"] = results[

@@ -164,6 +164,26 @@ def main() -> int:
     from ray_tpu.serve import llm as serve_llm
     from ray_tpu.utils.metrics import hist_quantile
 
+    # sweep debris a SIGKILLed previous run left behind (orphaned
+    # daemons, stale shm) — leaked node_mains depress serve numbers —
+    # and record the host state the row was measured under, so an
+    # outlier in BENCH_SERVE.json is explainable after the fact
+    from ray_tpu.core.cluster_utils import sweep_stale_runtime
+
+    swept = sweep_stale_runtime()
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = -1.0
+    host_meta = {
+        "loadavg": [round(load1, 2), round(load5, 2), round(load15, 2)],
+        "cpus": os.cpu_count(),
+        "stale_killed": swept.get("killed", 0),
+        "stale_removed": swept.get("removed", 0),
+    }
+    if swept.get("killed") or swept.get("removed"):
+        print(json.dumps({"swept_stale_runtime": swept}), flush=True)
+
     rng = random.Random(args.seed)
     ray_tpu.init(num_cpus=max(8, args.replicas * 2))
     serve.start(http_port=0)
@@ -271,6 +291,7 @@ def main() -> int:
 
         row = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "host": host_meta,
             "rate_rps": args.rate,
             "duration_s": args.duration,
             "replicas": args.replicas,

@@ -174,6 +174,20 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
         f"  |  events dropped "
         f"{scalar_sum('rt_task_events_dropped_total'):g}"
     )
+    # -- profiling / forensics status (one line; "off" when the
+    # continuous sampler isn't running anywhere) --
+    hz_series = metric("rt_profiler_hz")["series"].values()
+    cont_hz = max(hz_series, default=0.0)
+    samples = scalar_sum("rt_profile_samples_total")
+    stalls = scalar_sum("rt_task_stalls_total")
+    prof = (
+        f"continuous @ {cont_hz:g} Hz, {int(samples):,} samples"
+        if cont_hz > 0 else "continuous off"
+    )
+    out.append(
+        f"profiling: {prof}  |  task stalls {int(stalls)}"
+        + ("  <-- hung tasks flagged; run `rt stacks`" if stalls else "")
+    )
 
     # -- serve: one row per deployment --
     rows: dict = {}
@@ -360,6 +374,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     top.add_argument("--since", type=float, default=60.0,
                      help="trailing window (s) for the history-derived "
                           "columns (windowed TTFT p95, QPS sparkline)")
+    prof = sub.add_parser(
+        "profile",
+        help="fleet-wide sampling profile: capture stacks on every live "
+             "process for --duration seconds, merge, and report the "
+             "per-subsystem split (+ folded stacks / flamegraph files)",
+    )
+    prof.add_argument("--duration", type=float, default=10.0,
+                      help="capture window in seconds (server-capped by "
+                           "RT_PROFILER_MAX_DURATION_S)")
+    prof.add_argument("--hz", type=float, default=99.0,
+                      help="sampling rate per process")
+    prof.add_argument("--out", default="profile.folded",
+                      help="write merged folded stacks here ('' to skip)")
+    prof.add_argument("--html", default="profile.html",
+                      help="write a self-contained flamegraph here "
+                           "('' to skip)")
+    stacks = sub.add_parser(
+        "stacks",
+        help="dump every thread's Python stack from every live process "
+             "(hang triage; no restart, no signals)",
+    )
+    stacks.add_argument("--node", default=None,
+                        help="node-id prefix: only that node's agent "
+                             "and workers")
+    pm = sub.add_parser(
+        "postmortem",
+        help="render crash flight-recorder black boxes (periodic "
+             "snapshot of events/tasks/rss survives kill -9) and "
+             "faulthandler crash files for dead processes",
+    )
+    pm.add_argument("target", nargs="?", default=None,
+                    help="a pid or a node-id prefix (default: all)")
+    pm.add_argument("--all", action="store_true", dest="show_alive",
+                    help="include live processes, not just dead ones")
     dash = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     dash.add_argument("--port", type=int, default=8265)
     dash.add_argument(
@@ -670,6 +718,82 @@ def main(argv: Optional[List[str]] = None) -> int:
                 _time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
+    if args.cmd == "profile":
+        from ray_tpu.observability import profiler as profiler_mod
+
+        merged = state.profile(
+            duration_s=args.duration, hz=args.hz, address=addr
+        )
+        if args.as_json:
+            print(json.dumps(merged, indent=2))
+            return 0
+        print(
+            f"profiled {merged['processes']}/{merged['targets']} processes "
+            f"for {merged['duration_s']:g}s @ {merged['hz']:g} Hz — "
+            f"{merged['samples']} thread samples"
+        )
+        print()
+        print(profiler_mod.subsystem_table(merged["subsystems"]))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(profiler_mod.folded_text(merged["folded"]))
+            print(f"\nwrote {args.out} (collapsed stacks; flamegraph.pl "
+                  f"/ speedscope compatible)")
+        if args.html:
+            with open(args.html, "w") as f:
+                f.write(profiler_mod.flamegraph_html(
+                    merged["folded"],
+                    title=f"rt profile — {merged['samples']} samples",
+                ))
+            print(f"wrote {args.html} (self-contained flamegraph)")
+        return 0
+    if args.cmd == "stacks":
+        from ray_tpu.observability import forensics as forensics_mod
+
+        dumps = state.stacks(address=addr, node=args.node)
+        if args.as_json:
+            print(json.dumps(dumps, indent=2))
+            return 0
+        if not dumps:
+            print("no live processes reachable")
+            return 1
+        for dump in dumps:
+            print(f"==> {dump.get('role', '?')} pid {dump.get('pid')} "
+                  f"@ {dump.get('address')} <==")
+            print(forensics_mod.format_stack_dump(dump))
+            print()
+        return 0
+    if args.cmd == "postmortem":
+        from ray_tpu.observability import forensics as forensics_mod
+
+        pid = node = None
+        if args.target:
+            if args.target.isdigit():
+                pid = int(args.target)
+            else:
+                node = args.target
+        try:
+            reports = state.crash_reports(address=addr, pid=pid, node=node)
+        except RuntimeError:
+            # no cluster reachable — scan this host's crash dirs directly
+            # (the dead-cluster case is exactly when postmortems matter)
+            reports = forensics_mod.list_crash_reports(pid=pid)
+        if not args.show_alive:
+            dead = [r for r in reports if not r.get("alive")]
+            # with an explicit pid target show it regardless of liveness
+            reports = reports if (pid is not None and not dead) else dead
+        if args.as_json:
+            print(json.dumps(reports, indent=2, default=str))
+            return 0
+        if not reports:
+            print("no crash artifacts found"
+                  + ("" if args.show_alive else " for dead processes "
+                     "(--all includes live ones)"))
+            return 0
+        for rec in reports:
+            print(forensics_mod.render_report(rec))
+            print()
+        return 0
     if args.cmd == "dashboard":
         import time as _time
 

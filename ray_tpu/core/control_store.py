@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import scheduling
 from ray_tpu.core.ha import FileBackend, HAState, write_head_address
-from ray_tpu.observability import core_metrics
+from ray_tpu.observability import core_metrics, forensics, profiler
 from ray_tpu.utils.config import config
 from ray_tpu.utils import rpc
 from ray_tpu.utils.ids import ActorID, JobID, NodeID, PlacementGroupID
@@ -203,6 +203,9 @@ class ControlStore:
             target=self._sched_loop, name="cs-scheduler", daemon=True
         ).start()
         self._start_observability()
+        # continuous sampler rides observability_enabled + profiler_hz
+        # only — it is useful precisely when the history sampler is off
+        profiler.maybe_start_continuous()
         if self._recovering:
             threading.Thread(
                 target=self._reconcile_loop, name="cs-reconcile", daemon=True
@@ -968,6 +971,20 @@ class ControlStore:
         if eng is None:
             return {"enabled": False, "alerts": []}
         return {"enabled": True, "alerts": eng.describe()}
+
+    def rpc_profile(self, conn, duration_s: float = 5.0,
+                    hz: float = 99.0):
+        """Sample the head process's threads. The caller-supplied
+        duration is capped so a profile RPC can hold a dispatcher
+        thread for at most profiler_max_duration_s."""
+        duration_s = min(
+            float(duration_s), float(config.profiler_max_duration_s)
+        )
+        return profiler.capture(duration_s=duration_s, hz=hz)
+
+    def rpc_stack_dump(self, conn):
+        """All-thread stacks from the head process (hang forensics)."""
+        return forensics.all_thread_stacks()
 
     def _public_node(self, node_id: str) -> Dict[str, Any]:
         n = self._nodes[node_id]

@@ -57,6 +57,12 @@ def main(argv=None) -> None:
     if args.address_file:
         config.set("ha_head_address_file", args.address_file)
 
+    # Crash flight recorder before the control store boots: a head
+    # segfault mid-WAL-replay must leave a traceback.
+    from ray_tpu.observability import forensics
+
+    forensics.install("head")
+
     from ray_tpu.core.control_store import ControlStore
     from ray_tpu.utils.gateway import Gateway
 

@@ -34,7 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import scheduling
 from ray_tpu.core.object_store import ShmObjectStore
-from ray_tpu.observability import core_metrics
+from ray_tpu.observability import core_metrics, forensics, profiler
 from ray_tpu.utils import serialization
 from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import NodeID
@@ -184,6 +184,12 @@ class NodeAgent:
             retryable=True,
         )
         config.load_snapshot(reply["config_snapshot"])
+        # Session-scoped crash dir: this process's faulthandler + black
+        # box re-point here, and spawned workers inherit it via
+        # RT_CRASH_DIR (boot crashes landed in the temp_dir default).
+        os.environ["RT_CRASH_DIR"] = os.path.join(self.temp_dir, "crash")
+        forensics.install(forensics.current_role() or "driver")
+        profiler.maybe_start_continuous()
         t = threading.Thread(target=self._heartbeat_loop, name="agent-hb", daemon=True)
         t.start()
         self._threads.append(t)
@@ -561,6 +567,7 @@ class NodeAgent:
             env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = pythonpath
         env["RT_CONFIG_SNAPSHOT"] = config.snapshot()
+        env["RT_CRASH_DIR"] = os.path.join(self.temp_dir, "crash")
         python = sys.executable
         if env_spec:
             # boot the worker INSIDE its runtime env: pip envs get the
@@ -1379,7 +1386,61 @@ class NodeAgent:
             }
             entry.update(live.get(base, {}))
             logs.append(entry)
+        # crash artifacts (faulthandler files + black boxes) surface
+        # through the same listing — they too outlive their process
+        crash_d = os.path.join(self.temp_dir, "crash")
+        try:
+            crash_names = sorted(os.listdir(crash_d))
+        except OSError:
+            crash_names = []
+        for fname in crash_names:
+            if fname.startswith("crash-"):
+                stream = "crash"
+            elif fname.startswith("blackbox-") and fname.endswith(".json"):
+                stream = "blackbox"
+            else:
+                continue
+            path = os.path.join(crash_d, fname)
+            try:
+                size = os.path.getsize(path)
+                with open(path, "rb") as f:
+                    if size > tail_bytes:
+                        f.seek(size - tail_bytes)
+                    data = f.read(tail_bytes)
+            except OSError:
+                continue
+            logs.append({
+                "node_id": self.node_id.hex(),
+                "file": fname,
+                "stream": stream,
+                "size": size,
+                "tail": data.decode(errors="replace"),
+            })
         return logs
+
+    def rpc_profile(self, conn, duration_s: float = 5.0,
+                    hz: float = 99.0):
+        """Sample this agent process's threads. The caller-supplied
+        duration is capped so a profile RPC can hold a dispatcher
+        thread for at most profiler_max_duration_s."""
+        duration_s = min(
+            float(duration_s), float(config.profiler_max_duration_s)
+        )
+        return profiler.capture(duration_s=duration_s, hz=hz)
+
+    def rpc_stack_dump(self, conn):
+        """All-thread stacks from this agent (hang forensics)."""
+        return forensics.all_thread_stacks()
+
+    def rpc_crash_reports(self, conn, pid: Optional[int] = None):
+        """Crash artifacts on this node — black boxes + faulthandler
+        files, dead workers included (`rt postmortem`)."""
+        return {
+            "node_id": self.node_id.hex(),
+            "reports": forensics.list_crash_reports(
+                dirs=[os.path.join(self.temp_dir, "crash")], pid=pid
+            ),
+        }
 
     def rpc_get_metrics(self, conn):
         """This process's metric registry (lease/pool/object-store series

@@ -35,6 +35,12 @@ def main() -> None:
     if snapshot:
         config.load_snapshot(snapshot)
 
+    # Crash flight recorder before anything else can segfault; the
+    # agent re-points the crash dir at its session dir in start().
+    from ray_tpu.observability import forensics
+
+    forensics.install("node")
+
     from ray_tpu.core.node_agent import NodeAgent
 
     agent = NodeAgent(

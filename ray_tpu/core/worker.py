@@ -51,7 +51,7 @@ from ray_tpu.core.object_store import (
     pwritev_all,
 )
 from ray_tpu.core.task import TaskOptions, TaskSpec
-from ray_tpu.observability import core_metrics, tracing
+from ray_tpu.observability import core_metrics, forensics, profiler, tracing
 from ray_tpu.utils import serialization
 from ray_tpu.utils.config import config
 from ray_tpu.utils.ids import ActorID, JobID, ObjectID, TaskID, WorkerID
@@ -626,6 +626,38 @@ class CoreWorker:
         self._subscribe_actor_updates()
         t = threading.Thread(target=self._agent_watchdog, name="agent-watch", daemon=True)
         t.start()
+        if forensics.ENABLED and float(config.task_stall_dump_s) > 0:
+            threading.Thread(
+                target=self._stall_watchdog, name="stall-watch",
+                daemon=True,
+            ).start()
+        profiler.maybe_start_continuous()
+
+    def _stall_watchdog(self) -> None:
+        """Flag tasks running past ``task_stall_dump_s``: ONE
+        ``{"type": "stall"}`` event per task occurrence, carrying the
+        stuck thread's stack into the event ring (forensics)."""
+        threshold = float(config.task_stall_dump_s)
+        period = min(max(threshold / 4.0, 0.05), 2.0)
+        stamped: set = set()
+        while not self._shutdown.wait(period):
+            now = time.monotonic()
+            for tid_hex, info in list(self._running_tasks.items()):
+                t0 = info.get("t0")
+                if t0 is None or now - t0 < threshold \
+                        or tid_hex in stamped:
+                    continue
+                stamped.add(tid_hex)
+                if forensics.ENABLED:
+                    forensics.stamp_stall(
+                        task_id=tid_hex,
+                        name=info.get("name", ""),
+                        elapsed_s=now - t0,
+                        thread_ident=info.get("tid"),
+                        worker_address=self.address,
+                    )
+            # forget finished tasks so the one-shot set stays bounded
+            stamped &= set(self._running_tasks)
 
     def _agent_watchdog(self) -> None:
         """Exit if the node agent goes away (orphan prevention: a node's
@@ -2533,6 +2565,7 @@ class CoreWorker:
         self._current_ctx.job_id = spec.task_id.job_id()
         self._running_tasks[spec.task_id.hex()] = {
             "name": spec.name, "tid": threading.get_ident(),
+            "t0": time.monotonic(),
         }
         _t0 = time.time()
         try:
@@ -2652,6 +2685,16 @@ class CoreWorker:
             "token": metrics_mod.PROCESS_TOKEN,
             "metrics": metrics_mod.snapshot_all(),
         }
+
+    def rpc_profile(self, conn, duration_s: float = 5.0,
+                    hz: float = 99.0):
+        """Sample this worker's threads for ``duration_s`` at ``hz``
+        (both clamped inside profiler.capture)."""
+        return profiler.capture(duration_s=duration_s, hz=hz)
+
+    def rpc_stack_dump(self, conn):
+        """All-thread stacks from this live worker (hang forensics)."""
+        return forensics.all_thread_stacks()
 
     def rpc_borrow_stats(self, conn):
         """Owner-side reference state for `state.objects()` / `rt memory`

@@ -35,6 +35,14 @@ def main() -> None:
     if snapshot:
         config.load_snapshot(snapshot)
 
+    # Crash flight recorder FIRST (satellite contract: independent of
+    # profiler flags) — a SIGSEGV in native channel/shm code must leave
+    # a traceback even if the worker dies before registering. The black
+    # box thread inside install() is observability-gated.
+    from ray_tpu.observability import forensics
+
+    forensics.install("worker")
+
     from ray_tpu.core import worker as worker_mod
 
     w = worker_mod.CoreWorker(

@@ -9,6 +9,8 @@ same data to programmatic consumers.
 Endpoints: /           HTML summary (auto-refresh)
            /api/status /api/nodes /api/actors /api/jobs /api/workers
            /api/placement_groups /api/timeline /api/alerts
+           /api/profile?duration_s=&hz= (fleet sampling profile, merged)
+           /api/stacks?node= (all-thread dumps)  /api/crash_reports
            /api/metrics/history?name=&window_s=&step_s=&tags={...}
            /metrics (Prometheus text)
 """
@@ -42,6 +44,8 @@ _PAGE = """<!doctype html>
 <p>APIs: /api/status /api/nodes /api/actors /api/jobs /api/workers
 /api/placement_groups /api/timeline /api/task_summary
 /api/request_summary /api/alerts
+/api/profile?duration_s=&amp;hz= /api/stacks?node=
+/api/crash_reports?pid=&amp;node=
 /api/metrics/history?name=&amp;window_s=&amp;step_s=&amp;tags= /metrics</p>
 </body></html>"""
 
@@ -152,6 +156,21 @@ class Dashboard:
             "/api/task_summary": lambda: state.task_summary(addr),
             "/api/request_summary": lambda: state.request_summary(addr),
             "/api/alerts": lambda: state.alerts(addr),
+            "/api/profile": lambda: state.profile(
+                duration_s=(
+                    float(qs["duration_s"]) if qs.get("duration_s") else 5.0
+                ),
+                hz=float(qs["hz"]) if qs.get("hz") else 99.0,
+                address=addr,
+            ),
+            "/api/stacks": lambda: state.stacks(
+                address=addr, node=qs.get("node"),
+            ),
+            "/api/crash_reports": lambda: state.crash_reports(
+                address=addr,
+                pid=int(qs["pid"]) if qs.get("pid") else None,
+                node=qs.get("node"),
+            ),
             "/api/metrics/history": lambda: state.metrics_history(
                 name=qs.get("name"),
                 tags=json.loads(qs["tags"]) if qs.get("tags") else None,

@@ -21,10 +21,21 @@ attributes so a disabled hot path pays a single attribute check):
   evaluated over the history store on every sampler tick, surfaced via
   ``state.alerts()`` / ``rt alerts`` / ``/api/alerts``. Disabled with
   ``RT_ALERTS_ENABLED=0`` (or whenever the sampler is off).
+- ``profiler`` — sampling profiler over ``sys._current_frames()``:
+  on-demand fleet captures (``state.profile()`` / ``rt profile`` →
+  folded stacks + flamegraph HTML with per-subsystem attribution) and
+  an optional continuous mode (``RT_PROFILER_HZ``, default off)
+  feeding ``rt_profile_samples_total{subsystem}``.
+- ``forensics`` — hang + crash artifacts: ``rpc_stack_dump`` /
+  ``rt stacks``, the worker stall watchdog's ``{"type": "stall"}``
+  ring events (``RT_TASK_STALL_DUMP_S``), per-process ``faulthandler``
+  crash files and the periodic black box that ``rt postmortem``
+  renders after a kill -9.
 
 ``history`` and ``alerts`` are NOT imported here: they run only on the
 head and are imported by the control store at start, keeping worker
-import cost flat.
+import cost flat. ``profiler`` and ``forensics`` are imported by the
+process mains / RPC handlers that wire them in.
 """
 
 from ray_tpu.observability import core_metrics, tracing  # noqa: F401

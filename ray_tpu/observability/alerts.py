@@ -235,10 +235,14 @@ class AlertEngine:
                 self._stamp(rule, PENDING, value, now)
                 cur = PENDING
             if cur == PENDING and now - st["pending_since"] >= rule.for_s:
+                # stamp BEFORE flipping the describe()-visible state: the
+                # firing stamp can be slow (page severity attaches a
+                # forensics capture), and a poller that sees "firing" via
+                # rpc_alerts must also find the firing instant in the ring
+                self._stamp(rule, FIRING, value, now)
                 st["state"] = FIRING
                 st["since"] = now
                 st["last_transition_ts"] = now
-                self._stamp(rule, FIRING, value, now)
         else:
             if cur == FIRING:
                 self._stamp(rule, RESOLVED, value, now)
@@ -264,6 +268,16 @@ class AlertEngine:
             "ts_us": tracing.now_us(),
             "pid": os.getpid(),
         }
+        if state == FIRING and rule.severity == "page":
+            # one automatic hang-forensics capture rides the page event
+            # (rate-limited by alert_capture_min_interval_s): the stacks
+            # at firing time are exactly what the responder wants and
+            # are gone by the time a human runs `rt stacks`
+            from ray_tpu.observability import forensics
+
+            capture = forensics.maybe_alert_capture()
+            if capture is not None:
+                evt["stacks"] = capture
         if self._emit is not None:
             self._emit(evt)
         else:

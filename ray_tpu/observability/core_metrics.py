@@ -238,6 +238,21 @@ def _build() -> dict:
             "nodes currently marked dead by the head's heartbeat health "
             "loop (feeds the node_heartbeat_missed alert rule)",
         ),
+        # -- profiler + forensics (observability/profiler.py, forensics.py) --
+        "profile_samples": Counter(
+            "rt_profile_samples_total",
+            "continuous-sampler stack samples by attributed subsystem",
+            tag_keys=("subsystem",),
+        ),
+        "profiler_continuous_hz": Gauge(
+            "rt_profiler_hz",
+            "continuous sampler rate in this process (0 = off)",
+        ),
+        "task_stalls": Counter(
+            "rt_task_stalls_total",
+            "tasks flagged by the stall watchdog (ran past "
+            "task_stall_dump_s without finishing)",
+        ),
         # total KV capacity next to rt_serve_kv_slots_occupied so the
         # occupancy RATIO is computable by the alert engine without
         # knowing every deployment's max_batch_size

@@ -1,0 +1,513 @@
+"""Hang forensics + crash flight recorder.
+
+Three artifacts, one module:
+
+- **Stack dumps** (:func:`all_thread_stacks`) — every live thread's
+  frames, served by ``rpc_stack_dump`` on the worker / agent / head and
+  fanned out by ``state.stacks()`` / ``rt stacks``. The worker's stall
+  watchdog reuses the same walker to stamp a one-shot
+  ``{"type": "stall"}`` event (:func:`stall_event`) carrying the stuck
+  thread's stack into the task event ring, joinable by task_id in
+  ``state.timeline()``. Firing page-severity alerts attach one
+  rate-limited capture (:func:`maybe_alert_capture`).
+- **Crash files** (:func:`enable_crash_handler`) — ``faulthandler``
+  pointed at a per-process ``crash-<role>-<pid>.log`` under the crash
+  dir, so SIGSEGV/SIGABRT/SIGBUS in native channel/shm code leaves a
+  traceback instead of vanishing. Enabled unconditionally at boot in
+  every spawned process (a crash recorder you can switch off records
+  nothing).
+- **Black box** (:class:`BlackBoxWriter`, thread name ``rt-blackbox``)
+  — a compact JSON snapshot (last ~256 ring events, active task ids,
+  rss/fds, uptime) rewritten atomically every ``blackbox_interval_s``.
+  SIGKILL runs no handler, so the *periodic* rewrite is the artifact
+  that survives kill -9; atexit adds a final flush for clean exits.
+  ``rt postmortem`` renders the black box of a dead process.
+
+The crash dir is ``RT_CRASH_DIR`` (the node agent points spawned
+workers at ``<session dir>/crash``) falling back to
+``<temp_dir>/crash``.
+
+Import discipline: only ``ray_tpu.utils.*`` at module import;
+``ray_tpu.core.worker`` is imported at use (same pattern as
+``tracing.emit``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import faulthandler
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.utils.config import config
+from ray_tpu.utils.metrics import PROCESS_TOKEN
+
+ENABLED = bool(config.observability_enabled)
+
+BLACKBOX_THREAD_NAME = "rt-blackbox"
+BLACKBOX_EVENTS = 256
+
+
+def set_enabled(on: bool) -> None:
+    global ENABLED
+    ENABLED = bool(on)
+    config.set("observability_enabled", bool(on))
+
+
+def crash_dir() -> str:
+    """This process's crash-artifact directory."""
+    d = str(config.crash_dir or "")
+    return d or os.path.join(str(config.temp_dir), "crash")
+
+
+def current_role() -> str:
+    """Role this process installed the crash handler under ("" before
+    install) — lets the node agent re-point an already-installed
+    handler at the session crash dir without renaming it."""
+    return str(_state["role"])
+
+
+# --- stack dumps -----------------------------------------------------------
+
+def all_thread_stacks(
+    skip_idents: Optional[set] = None,
+) -> Dict[str, Any]:
+    """Every live thread's stack, leaf-last, as plain dicts."""
+    from ray_tpu.observability import tracing
+
+    skip = skip_idents or set()
+    names = {t.ident: (t.name, t.daemon) for t in threading.enumerate()}
+    threads: List[Dict[str, Any]] = []
+    for ident, frame in sys._current_frames().items():
+        if ident in skip:
+            continue
+        name, daemon = names.get(ident, (f"tid-{ident}", True))
+        # lookup_lines=False: we only keep file/line/func, and reading
+        # source text for every frame of every thread is file I/O the
+        # alert path can't afford. walk_stack yields leaf-first, so
+        # reverse to keep extract_stack's leaf-last order.
+        summary = traceback.StackSummary.extract(
+            traceback.walk_stack(frame), lookup_lines=False)
+        summary.reverse()
+        frames = [
+            {"file": fs.filename, "line": fs.lineno, "func": fs.name}
+            for fs in summary
+        ]
+        threads.append({
+            "ident": ident,
+            "name": name,
+            "daemon": daemon,
+            "frames": frames,
+        })
+    threads.sort(key=lambda t: (t["daemon"], t["name"]))
+    return {
+        "pid": os.getpid(),
+        "token": PROCESS_TOKEN,
+        "role": _state["role"],
+        "ts_us": tracing.now_us(),
+        "threads": threads,
+    }
+
+
+def thread_stack(ident: int) -> List[Dict[str, Any]]:
+    """One thread's current frames (leaf-last), or [] if it's gone."""
+    frame = sys._current_frames().get(ident)
+    if frame is None:
+        return []
+    summary = traceback.StackSummary.extract(
+        traceback.walk_stack(frame), lookup_lines=False)
+    summary.reverse()
+    return [
+        {"file": fs.filename, "line": fs.lineno, "func": fs.name}
+        for fs in summary
+    ]
+
+
+def format_stack_dump(dump: Dict[str, Any]) -> str:
+    lines = [f"pid {dump.get('pid')} — {len(dump.get('threads', []))} "
+             f"thread(s)"]
+    for t in dump.get("threads", []):
+        flag = " daemon" if t.get("daemon") else ""
+        lines.append(f"  thread {t.get('name')} (ident "
+                     f"{t.get('ident')}{flag}):")
+        for fr in t.get("frames", []):
+            lines.append(f"    {fr['file']}:{fr['line']} in {fr['func']}")
+    return "\n".join(lines)
+
+
+# --- stall watchdog event --------------------------------------------------
+
+def stall_event(
+    task_id: str,
+    name: str,
+    elapsed_s: float,
+    thread_ident: Optional[int],
+    worker_address: str,
+) -> Dict[str, Any]:
+    """Build the one-shot stall event for a task running past
+    ``task_stall_dump_s``, carrying the stuck thread's stack."""
+    from ray_tpu.observability import tracing
+
+    return {
+        "type": "stall",
+        "task_id": task_id,
+        "name": name,
+        "elapsed_s": round(float(elapsed_s), 3),
+        "stack": thread_stack(thread_ident) if thread_ident else [],
+        "thread": thread_ident,
+        "ts_us": tracing.now_us(),
+        "worker": worker_address,
+        "pid": os.getpid(),
+    }
+
+
+def stamp_stall(
+    task_id: str,
+    name: str,
+    elapsed_s: float,
+    thread_ident: Optional[int],
+    worker_address: str,
+) -> Dict[str, Any]:
+    """Stamp one stall event into the event ring and bump the counter.
+    Callers guard with ``if forensics.ENABLED:`` (rtlint metric-guards
+    contract); the inner tracing/core_metrics flags gate the sinks."""
+    from ray_tpu.observability import core_metrics, tracing
+
+    evt = stall_event(task_id, name, elapsed_s, thread_ident,
+                      worker_address)
+    if tracing.ENABLED:
+        tracing.emit(evt)
+    if core_metrics.ENABLED:
+        core_metrics.task_stalls.inc()
+    return evt
+
+
+# --- alert-triggered capture ----------------------------------------------
+
+_last_alert_capture = [0.0]
+_alert_capture_lock = threading.Lock()
+
+
+def maybe_alert_capture() -> Optional[Dict[str, Any]]:
+    """One all-thread capture for a firing page-severity alert, at most
+    once per ``alert_capture_min_interval_s``. None when rate-limited."""
+    min_interval = float(config.alert_capture_min_interval_s)
+    with _alert_capture_lock:
+        now = time.monotonic()
+        if (_last_alert_capture[0]
+                and now - _last_alert_capture[0] < min_interval):
+            return None
+        _last_alert_capture[0] = now
+    return all_thread_stacks()
+
+
+# --- crash flight recorder -------------------------------------------------
+
+# Keep strong refs: faulthandler writes to the raw fd at crash time, so
+# the file object must never be garbage collected.
+_crash_file = None
+_state: Dict[str, Any] = {
+    "role": "",
+    "started_ts": time.time(),
+    "crash_path": "",
+    "blackbox_path": "",
+}
+_blackbox: Optional["BlackBoxWriter"] = None
+_install_lock = threading.Lock()
+
+
+def enable_crash_handler(role: str) -> str:
+    """Point ``faulthandler`` at ``crash-<role>-<pid>.log`` in the crash
+    dir and write a header line. Safe to call more than once (the last
+    call wins). Returns the crash-file path."""
+    global _crash_file
+    d = crash_dir()
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"crash-{role}-{os.getpid()}.log")
+    f = open(path, "a")
+    f.write(json.dumps({
+        "role": role,
+        "pid": os.getpid(),
+        "argv": sys.argv,
+        "started_ts": _state["started_ts"],
+    }) + "\n")
+    f.flush()
+    faulthandler.enable(file=f, all_threads=True)
+    with _install_lock:
+        old, _crash_file = _crash_file, f
+    if old is not None:
+        try:
+            old.close()
+        except OSError:
+            pass
+    _state["role"] = role
+    _state["crash_path"] = path
+    return path
+
+
+def _proc_rss_fds() -> Dict[str, Any]:
+    rss_kb = None
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss_kb = int(line.split()[1])
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        open_fds: Optional[int] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        open_fds = None
+    return {"rss_kb": rss_kb, "open_fds": open_fds}
+
+
+def blackbox_snapshot() -> Dict[str, Any]:
+    """The compact black box: process vitals + the tail of the event
+    ring + active task ids."""
+    from ray_tpu.core import worker as _worker_mod
+
+    now = time.time()
+    snap: Dict[str, Any] = {
+        "pid": os.getpid(),
+        "role": _state["role"],
+        "argv": sys.argv,
+        "started_ts": _state["started_ts"],
+        "updated_ts": now,
+        "uptime_s": round(now - _state["started_ts"], 3),
+        "crash_path": _state["crash_path"],
+    }
+    snap.update(_proc_rss_fds())
+    w = _worker_mod.global_worker_or_none()
+    if w is not None:
+        try:
+            snap["active_tasks"] = {
+                tid: {
+                    "name": info.get("name", ""),
+                    "elapsed_s": round(
+                        time.monotonic() - info["t0"], 3
+                    ) if info.get("t0") else None,
+                }
+                for tid, info in list(w._running_tasks.items())
+            }
+            snap["events"] = list(w._task_events)[-BLACKBOX_EVENTS:]
+        except (AttributeError, RuntimeError):
+            pass
+    return snap
+
+
+def write_blackbox() -> str:
+    """Atomically rewrite this process's black box (tmp + rename, so a
+    SIGKILL mid-write still leaves the previous snapshot)."""
+    d = crash_dir()
+    os.makedirs(d, exist_ok=True)
+    role = _state["role"] or "proc"
+    path = os.path.join(d, f"blackbox-{role}-{os.getpid()}.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(blackbox_snapshot(), f, default=repr)
+    os.replace(tmp, path)
+    _state["blackbox_path"] = path
+    return path
+
+
+class BlackBoxWriter(threading.Thread):
+    """Periodic black-box rewriter — the snapshot that survives
+    kill -9."""
+
+    def __init__(self, interval_s: Optional[float] = None):
+        super().__init__(name=BLACKBOX_THREAD_NAME, daemon=True)
+        self.interval_s = float(
+            config.blackbox_interval_s if interval_s is None
+            else interval_s
+        )
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                write_blackbox()
+            except OSError:
+                pass
+            self._stop.wait(max(self.interval_s, 0.2))
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _atexit_blackbox() -> None:
+    try:
+        write_blackbox()
+    except OSError:
+        pass
+
+
+def install(role: str) -> str:
+    """Boot hook for head/node/worker mains: always enable the crash
+    handler (satellite contract — independent of profiler flags);
+    start the black-box writer only when observability is on, so
+    ``RT_OBSERVABILITY_ENABLED=0`` adds zero threads."""
+    global _blackbox
+    path = enable_crash_handler(role)
+    if ENABLED:
+        with _install_lock:
+            if _blackbox is None or not _blackbox.is_alive():
+                _blackbox = BlackBoxWriter()
+                _blackbox.start()
+                atexit.register(_atexit_blackbox)
+        try:
+            write_blackbox()
+        except OSError:
+            pass
+    return path
+
+
+# --- postmortem scan / render ----------------------------------------------
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+def _parse_artifact(fn: str) -> Optional[Dict[str, Any]]:
+    """``blackbox-<role>-<pid>.json`` / ``crash-<role>-<pid>.log`` →
+    {kind, role, pid}."""
+    base = os.path.basename(fn)
+    for kind, prefix, suffix in (
+        ("blackbox", "blackbox-", ".json"),
+        ("crash", "crash-", ".log"),
+    ):
+        if base.startswith(prefix) and base.endswith(suffix):
+            stem = base[len(prefix):-len(suffix)]
+            role, _, pid_s = stem.rpartition("-")
+            try:
+                return {"kind": kind, "role": role, "pid": int(pid_s)}
+            except ValueError:
+                return None
+    return None
+
+
+def list_crash_reports(
+    dirs: Optional[List[str]] = None,
+    pid: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Crash artifacts grouped per (role, pid): blackbox + crash-file
+    paths, liveness, and the parsed black box for dead processes."""
+    if dirs is None:
+        dirs = scan_dirs()
+    grouped: Dict[tuple, Dict[str, Any]] = {}
+    for d in dirs:
+        try:
+            entries = sorted(os.listdir(d))
+        except OSError:
+            continue
+        for base in entries:
+            meta = _parse_artifact(base)
+            if meta is None:
+                continue
+            if pid is not None and meta["pid"] != pid:
+                continue
+            key = (meta["role"], meta["pid"])
+            rec = grouped.setdefault(key, {
+                "role": meta["role"],
+                "pid": meta["pid"],
+                "alive": _pid_alive(meta["pid"]),
+                "blackbox_path": None,
+                "crash_path": None,
+            })
+            rec[meta["kind"] + "_path"] = os.path.join(d, base)
+    out = []
+    for rec in grouped.values():
+        bb = rec.get("blackbox_path")
+        if bb:
+            try:
+                with open(bb) as f:
+                    rec["blackbox"] = json.load(f)
+            except (OSError, ValueError):
+                rec["blackbox"] = None
+        out.append(rec)
+    out.sort(key=lambda r: (r["role"], r["pid"]))
+    return out
+
+
+def scan_dirs() -> List[str]:
+    """Every crash dir reachable from this host's temp_dir: the shared
+    default plus each session's crash dir."""
+    tmp = str(config.temp_dir)
+    dirs = [crash_dir(), os.path.join(tmp, "crash")]
+    try:
+        for entry in sorted(os.listdir(tmp)):
+            if entry.startswith("session_"):
+                dirs.append(os.path.join(tmp, entry, "crash"))
+    except OSError:
+        pass
+    seen: set = set()
+    out = []
+    for d in dirs:
+        if d not in seen:
+            seen.add(d)
+            out.append(d)
+    return out
+
+
+def render_report(rec: Dict[str, Any]) -> str:
+    """Human-readable postmortem for one (role, pid) record."""
+    lines = [
+        f"process {rec.get('role')}/{rec.get('pid')} — "
+        + ("ALIVE" if rec.get("alive") else "DEAD")
+    ]
+    bb = rec.get("blackbox")
+    if bb:
+        lines.append(
+            f"  uptime {bb.get('uptime_s', '?')}s, rss "
+            f"{bb.get('rss_kb', '?')} kB, {bb.get('open_fds', '?')} fds, "
+            f"last update {time.strftime('%H:%M:%S', time.localtime(bb.get('updated_ts', 0)))}"
+        )
+        active = bb.get("active_tasks") or {}
+        if active:
+            lines.append(f"  active tasks at last snapshot ({len(active)}):")
+            for tid, info in list(active.items())[:16]:
+                lines.append(
+                    f"    {tid[:16]} {info.get('name', '')} "
+                    f"(running {info.get('elapsed_s', '?')}s)"
+                )
+        events = bb.get("events") or []
+        if events:
+            lines.append(f"  last {len(events)} ring event(s), newest last:")
+            for evt in events[-12:]:
+                etype = evt.get("type") or "exec"
+                name = evt.get("name") or evt.get("phase") or \
+                    evt.get("component") or evt.get("op") or ""
+                tid = (evt.get("task_id") or evt.get("trace_id") or "")[:12]
+                lines.append(f"    [{etype}] {name} {tid}".rstrip())
+    elif rec.get("blackbox_path"):
+        lines.append(f"  black box unreadable: {rec['blackbox_path']}")
+    else:
+        lines.append("  no black box recorded")
+    cp = rec.get("crash_path")
+    if cp:
+        lines.append(f"  crash file: {cp}")
+        try:
+            with open(cp) as f:
+                tail = f.read().splitlines()
+        except OSError:
+            tail = []
+        # a crash file longer than its JSON header line means
+        # faulthandler fired — show the traceback tail
+        if len(tail) > 1:
+            lines.append("  crash traceback (tail):")
+            for ln in tail[-20:]:
+                lines.append(f"    {ln}")
+    return "\n".join(lines)

@@ -291,6 +291,24 @@ def timeline(address: Optional[str] = None,
                 },
             })
             continue
+        if etype == "stall":
+            # stall watchdog marker: a process-scoped instant carrying
+            # the stuck thread's stack, joinable by task_id
+            trace.append({
+                "name": f"stall:{e.get('name', '?')}",
+                "cat": "stall",
+                "ph": "i",
+                "s": "p",
+                "ts": e["ts_us"],
+                "pid": e.get("worker") or e.get("pid", 0),
+                "tid": e.get("pid", 0),
+                "args": {
+                    k: e[k]
+                    for k in ("task_id", "name", "elapsed_s", "stack")
+                    if k in e
+                },
+            })
+            continue
         if etype == "lifecycle":
             if e["phase"] == "submitted":
                 submits[e["task_id"]] = e
@@ -699,6 +717,132 @@ def alerts(address: Optional[str] = None) -> Dict[str, Any]:
     return _with_control(
         address, lambda c: c.call("alerts", timeout_s=10.0)
     )
+
+
+def _fleet_addresses(
+    address: Optional[str],
+    node: Optional[str] = None,
+) -> List[str]:
+    """Every profile/stack-dump target: control store + node agents +
+    workers (+ live drivers). A ``node`` id prefix narrows to that
+    node's agent and workers."""
+    agents = _agent_states(address)
+    if node:
+        agents = [
+            st for st in agents if st["node_id"].startswith(node)
+        ]
+        addrs = [st["address"] for st in agents]
+        for st in agents:
+            addrs.extend(
+                w["address"] for w in st.get("workers", {}).values()
+            )
+    else:
+        addrs = []
+        try:
+            addrs.append(_control(address).address)
+        except RuntimeError:
+            pass
+        addrs.extend(st["address"] for st in agents)
+        addrs.extend(_worker_addresses(address, agents=agents))
+    return list(dict.fromkeys(addrs))
+
+
+def profile(
+    duration_s: float = 5.0,
+    hz: float = 99.0,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Fleet-wide sampling profile (`rt profile`): fan ``rpc_profile``
+    to the control store, every node agent and every worker
+    concurrently, then merge the folded stacks. Replies carry a
+    per-process token, so the single-node case (head + agent + driver
+    in one process) counts each process once. The merged dict has
+    ``folded`` (stack -> samples), ``subsystems`` (subsystem ->
+    samples) and sampling totals."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ray_tpu.observability import profiler as profiler_mod
+
+    addrs = _fleet_addresses(address)
+
+    def one(addr: str):
+        try:
+            return _pool.get(addr).call(
+                "profile", duration_s=duration_s, hz=hz,
+                timeout_s=float(duration_s) + 30.0,
+            )
+        except RpcConnectionError:
+            _pool.drop(addr)
+            return None
+        except RpcError:
+            return None
+
+    with ThreadPoolExecutor(
+        max_workers=min(max(len(addrs), 1), 32),
+        thread_name_prefix="profile-fan",
+    ) as fan:
+        replies = list(fan.map(one, addrs))
+    merged = profiler_mod.merge(replies)
+    merged["targets"] = len(addrs)
+    merged["replies"] = sum(1 for r in replies if r)
+    merged["duration_s"] = float(duration_s)
+    merged["hz"] = float(hz)
+    return merged
+
+
+def stacks(
+    address: Optional[str] = None,
+    node: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """All-thread stack dumps from every live process in the fleet
+    (`rt stacks`), deduped by process token; ``node`` (node-id prefix)
+    narrows to one node's agent + workers."""
+    dumps: List[Dict[str, Any]] = []
+    seen: set = set()
+    for addr in _fleet_addresses(address, node=node):
+        try:
+            dump = _pool.get(addr).call("stack_dump", timeout_s=10.0)
+        except RpcConnectionError:
+            _pool.drop(addr)
+            continue
+        except RpcError:
+            continue
+        token = dump.get("token") if isinstance(dump, dict) else None
+        if token and token in seen:
+            continue
+        if token:
+            seen.add(token)
+        dump["address"] = addr
+        dumps.append(dump)
+    return dumps
+
+
+def crash_reports(
+    address: Optional[str] = None,
+    pid: Optional[int] = None,
+    node: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Crash artifacts (black boxes + faulthandler crash files) from
+    every node's session crash dir (`rt postmortem`), dead processes
+    included — that is the point."""
+    out: List[Dict[str, Any]] = []
+    for n in list_nodes(address):
+        if not n.get("alive", True):
+            continue
+        if node and not n["node_id"].startswith(node):
+            continue
+        try:
+            reply = _pool.get(n["address"]).call(
+                "crash_reports", pid=pid, timeout_s=10.0
+            )
+        except RpcConnectionError:
+            _pool.drop(n["address"])
+            continue
+        except RpcError:
+            continue
+        for rec in reply.get("reports", []):
+            out.append({**rec, "node_id": reply.get("node_id")})
+    return out
 
 
 def cluster_metrics(address: Optional[str] = None) -> Dict[str, Dict]:

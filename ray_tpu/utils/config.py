@@ -318,6 +318,25 @@ config.define("alerts_queue_depth_max", 64.0)
 config.define("alerts_kv_occupancy_frac", 0.95)
 config.define("alerts_for_s", 30.0)
 config.define("alerts_rules_extra", "")
+# Profiler + forensics plane (ISSUE 16, observability/profiler.py +
+# forensics.py). profiler_hz > 0 starts a low-rate continuous sampler
+# thread in every process (per-subsystem shares feed
+# rt_profile_samples_total); 0 = on-demand captures only. Server-side
+# rpc_profile durations are clamped to profiler_max_duration_s so a
+# caller can never pin a dispatcher thread indefinitely.
+config.define("profiler_hz", 0.0)
+config.define("profiler_max_duration_s", 60.0)
+# Stall watchdog: a worker task running longer than this gets ONE
+# {"type":"stall"} event carrying its thread stack stamped into the
+# event ring (0 disables the watchdog).
+config.define("task_stall_dump_s", 300.0)
+# Crash flight recorder: period of the black-box writer thread that
+# snapshots last-ring-events/active-tasks/rss to the crash dir (the
+# snapshot that survives SIGKILL).
+config.define("blackbox_interval_s", 5.0)
+# Firing page-severity alerts attach one all-thread stack capture to
+# the alert event, at most once per this interval.
+config.define("alert_capture_min_interval_s", 60.0)
 
 # --- Per-host / per-process flags (dynamic) ----------------------------
 # Re-read from the environment on every access and EXCLUDED from
@@ -345,3 +364,7 @@ config.define("usage_stats_enabled", True, dynamic=True)
 # Native (C/rust) data-plane toggle (native/__init__.py): RT_NATIVE=0
 # forces the pure-python fallbacks.
 config.define("native", True, dynamic=True)
+# Crash-file directory for THIS process (forensics.py). The node agent
+# points spawned workers at the session crash dir via RT_CRASH_DIR;
+# empty = <temp_dir>/crash. Per-process by construction, so dynamic.
+config.define("crash_dir", "", dynamic=True)

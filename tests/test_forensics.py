@@ -1,0 +1,256 @@
+"""Hang-forensics + crash flight-recorder tests (observability/
+forensics.py): the stall watchdog flags a sleep-blocked actor task with
+the sleeping frame, kill -9 mid-task leaves a parseable black box that
+`rt postmortem` renders, firing page alerts attach one rate-limited
+stack capture, and the crash-handler / black-box primitives round-trip."""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.observability import forensics
+from ray_tpu.utils.config import config
+
+
+@pytest.fixture(scope="module")
+def rt():
+    # fast thresholds BEFORE init so the config snapshot carries them to
+    # every spawned worker: 1 s stall watchdog, 0.3 s black-box cadence
+    old_stall = config.task_stall_dump_s
+    old_bb = config.blackbox_interval_s
+    config.set("task_stall_dump_s", 1.0)
+    config.set("blackbox_interval_s", 0.3)
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    config.set("task_stall_dump_s", old_stall)
+    config.set("blackbox_interval_s", old_bb)
+
+
+# -- units ------------------------------------------------------------------
+
+def test_stack_dump_and_format():
+    dump = forensics.all_thread_stacks()
+    assert dump["pid"] == os.getpid() and dump["token"]
+    me = [t for t in dump["threads"] if "MainThread" in t["name"]]
+    assert me and me[0]["frames"][-1]["func"] == "all_thread_stacks"
+    text = forensics.format_stack_dump(dump)
+    assert f"pid {os.getpid()}" in text
+    assert "in all_thread_stacks" in text
+
+
+def test_stall_event_carries_stack():
+    import threading
+
+    evt = forensics.stall_event(
+        task_id="abc123", name="my_task", elapsed_s=12.34,
+        thread_ident=threading.get_ident(), worker_address="1.2.3.4:5",
+    )
+    assert evt["type"] == "stall" and evt["task_id"] == "abc123"
+    assert evt["elapsed_s"] == pytest.approx(12.34)
+    funcs = [fr["func"] for fr in evt["stack"]]
+    assert "test_stall_event_carries_stack" in funcs
+    # a dead thread ident degrades to an empty stack, not a crash
+    assert forensics.stall_event("x", "y", 1.0, 999999999, "a")["stack"] == []
+
+
+def test_parse_artifact_names():
+    assert forensics._parse_artifact("blackbox-node-123.json") == {
+        "kind": "blackbox", "role": "node", "pid": 123,
+    }
+    assert forensics._parse_artifact("crash-head-worker-7.log") == {
+        "kind": "crash", "role": "head-worker", "pid": 7,
+    }
+    assert forensics._parse_artifact("blackbox-x-nan.json") is None
+    assert forensics._parse_artifact("unrelated.txt") is None
+
+
+def test_crash_handler_and_blackbox_roundtrip(tmp_path):
+    old = config.crash_dir
+    config.set("crash_dir", str(tmp_path))
+    try:
+        path = forensics.enable_crash_handler("testrole")
+        assert os.path.exists(path)
+        with open(path) as f:
+            header = json.loads(f.readline())
+        assert header["role"] == "testrole" and header["pid"] == os.getpid()
+
+        bb_path = forensics.write_blackbox()
+        with open(bb_path) as f:
+            bb = json.load(f)
+        assert bb["pid"] == os.getpid()
+        assert bb["role"] == "testrole"
+        assert bb["rss_kb"] > 0 and bb["open_fds"] > 0
+
+        reports = forensics.list_crash_reports(dirs=[str(tmp_path)])
+        rec = next(r for r in reports if r["pid"] == os.getpid())
+        assert rec["alive"] and rec["blackbox"]["role"] == "testrole"
+        rendered = forensics.render_report(rec)
+        assert "ALIVE" in rendered and "testrole" in rendered
+    finally:
+        config.set("crash_dir", old)
+        # re-point faulthandler at the session dir for later tests
+        forensics.enable_crash_handler("driver")
+
+
+def test_alert_capture_rate_limited():
+    old = config.alert_capture_min_interval_s
+    config.set("alert_capture_min_interval_s", 60.0)
+    forensics._last_alert_capture[0] = 0.0
+    try:
+        first = forensics.maybe_alert_capture()
+        assert first is not None and first["threads"]
+        assert forensics.maybe_alert_capture() is None  # rate-limited
+        # window elapsed -> capture again
+        forensics._last_alert_capture[0] -= 120.0
+        assert forensics.maybe_alert_capture() is not None
+    finally:
+        config.set("alert_capture_min_interval_s", old)
+        forensics._last_alert_capture[0] = 0.0
+
+
+def test_firing_page_alert_attaches_capture():
+    from ray_tpu.observability.alerts import FIRING, AlertEngine, Rule
+    from ray_tpu.observability.history import MetricsHistory
+    from ray_tpu.utils import metrics as metrics_mod
+
+    def snap(v):
+        g = {"kind": "gauge", "tag_keys": (), "series": {(): v},
+             "help": ""}
+        return {"g": g}
+
+    events = []
+    h = MetricsHistory(base_step_s=1.0, tiers=((1, 60),), max_series=16)
+    rule = Rule(name="pageme", kind="threshold", metric="g", op=">",
+                threshold=1.0, window_s=3.0, agg="max", for_s=0.0,
+                severity="page")
+    eng = AlertEngine([rule], h, emit=events.append)
+    forensics._last_alert_capture[0] = 0.0
+    for t in range(3):
+        h.record(float(t), snap(5.0))
+        eng.evaluate(now=float(t))
+    firing = [e for e in events if e["state"] == FIRING]
+    assert firing, events
+    stacks = firing[0].get("stacks")
+    assert stacks and stacks["threads"], (
+        "page-severity firing event must carry an automatic stack capture"
+    )
+    assert metrics_mod is not None
+    forensics._last_alert_capture[0] = 0.0
+
+
+# -- stall watchdog end-to-end ----------------------------------------------
+
+def test_sleep_blocked_actor_task_flags_stall(rt):
+    @ray_tpu.remote
+    class Sleeper:
+        def snooze(self, n):
+            time.sleep(n)
+            return "rested"
+
+    a = Sleeper.remote()
+    ref = a.snooze.remote(3.0)
+    # watchdog threshold is 1 s: the stall instant must appear while the
+    # task still runs
+    deadline = time.monotonic() + 15.0
+    stalls = []
+    while time.monotonic() < deadline and not stalls:
+        trace = state.timeline()
+        stalls = [e for e in trace if e.get("cat") == "stall"]
+        if not stalls:
+            time.sleep(0.3)
+    assert stalls, "no stall event for a 3 s task with a 1 s threshold"
+    evt = stalls[0]
+    # task names are actor-qualified ("<actor_id>.snooze")
+    assert evt["name"].startswith("stall:") and "snooze" in evt["name"]
+    args = evt["args"]
+    assert args["elapsed_s"] >= 1.0
+    funcs = [fr["func"] for fr in args["stack"]]
+    assert "snooze" in funcs, (
+        f"stall stack must name the sleeping frame, got {funcs}"
+    )
+    assert ray_tpu.get(ref) == "rested"  # one-shot: task still completes
+    # the stall counter reached the cluster rollup
+    mx = state.cluster_metrics()
+    total = sum((mx.get("rt_task_stalls_total") or {"series": {}})
+                ["series"].values())
+    assert total >= 1
+
+
+# -- crash flight recorder end-to-end ---------------------------------------
+
+def test_kill9_mid_task_leaves_parseable_blackbox(rt, capsys):
+    @ray_tpu.remote
+    class Doomed:
+        def pid(self):
+            return os.getpid()
+
+        def hang(self):
+            time.sleep(600)
+
+    a = Doomed.remote()
+    victim = ray_tpu.get(a.pid.remote())
+    ref = a.hang.remote()  # noqa: F841 — in flight when the axe falls
+    # let the 0.3 s black-box writer snapshot the active task
+    time.sleep(1.2)
+    os.kill(victim, signal.SIGKILL)
+    deadline = time.monotonic() + 10.0
+    rec = None
+    while time.monotonic() < deadline:
+        reports = state.crash_reports(pid=victim)
+        dead = [r for r in reports if not r.get("alive")]
+        if dead:
+            rec = dead[0]
+            break
+        time.sleep(0.3)
+    assert rec is not None, "no crash report for the SIGKILLed worker"
+    bb = rec["blackbox"]
+    assert bb and bb["pid"] == victim and bb["role"] == "worker"
+    active = bb.get("active_tasks") or {}
+    assert any(
+        str(info.get("name", "")).endswith("hang")
+        for info in active.values()
+    ), f"black box must pin the in-flight task, got {active}"
+
+    # `rt postmortem <pid>` renders it
+    from ray_tpu import cli
+    from ray_tpu.core import worker as worker_mod
+
+    addr = worker_mod.global_worker().control_address
+    rc = cli.main(["--address", addr, "postmortem", str(victim)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DEAD" in out and str(victim) in out and "hang" in out
+
+
+def test_worker_logs_surface_crash_files(rt):
+    logs = state.worker_logs()
+    streams = {entry["stream"] for entry in logs}
+    assert "crash" in streams, streams
+    assert "blackbox" in streams, streams
+    crash_files = [e for e in logs if e["stream"] == "crash"]
+    assert any("crash-" in e["file"] for e in crash_files)
+
+
+def test_rt_stacks_cli_shows_fleet(rt, capsys):
+    from ray_tpu import cli
+    from ray_tpu.core import worker as worker_mod
+
+    @ray_tpu.remote
+    class Pinned:
+        def ok(self):
+            return True
+
+    a = Pinned.remote()  # guarantee at least one live worker process
+    assert ray_tpu.get(a.ok.remote())
+    addr = worker_mod.global_worker().control_address
+    rc = cli.main(["--address", addr, "stacks"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "thread MainThread" in out
+    assert out.count("==>") >= 2  # driver + at least one worker

@@ -138,3 +138,47 @@ def test_honors_opt_out_mark():
             core_metrics.serve_router_requests.inc()  # obs: unguarded
     """)
     assert not violations, violations
+
+
+# -- profiler / forensics stamp helpers (PR 16 extension) -----------------
+
+def test_flags_unguarded_forensics_stamp():
+    violations = _check("""
+        def watchdog(self, tid):
+            forensics.stamp_stall(task_id=tid, name="t", elapsed_s=1.0,
+                                  thread_ident=None, worker_address="a")
+    """)
+    assert len(violations) == 1
+    assert "forensics.ENABLED" in violations[0]
+
+
+def test_accepts_guarded_forensics_stamp():
+    violations = _check("""
+        def watchdog(self, tid):
+            if forensics.ENABLED:
+                forensics.stamp_stall(task_id=tid, name="t",
+                                      elapsed_s=1.0, thread_ident=None,
+                                      worker_address="a")
+    """)
+    assert not violations, violations
+
+
+def test_profiler_stamp_requires_profiler_guard():
+    # a tracing guard does not satisfy a profiler stamp site
+    violations = _check("""
+        def tick(self):
+            if tracing.ENABLED:
+                profiler.stamp_sample("rpc")
+    """)
+    assert len(violations) == 1
+    assert "profiler.ENABLED" in violations[0]
+
+
+def test_non_stamp_profiler_calls_not_flagged():
+    violations = _check("""
+        def report(self):
+            profiler.capture(duration_s=1.0)
+            forensics.all_thread_stacks()
+            profiler.maybe_start_continuous()
+    """)
+    assert not violations, violations

@@ -1,0 +1,314 @@
+"""Sampling profiler tests (observability/profiler.py): subsystem
+attribution of stacks, folded-stack/flamegraph rendering, the
+fleet-wide `rt profile` fan-out under streaming serve load (>=90% of
+samples must attribute to a named subsystem), and the continuous
+low-rate sampler's lifecycle + kill switch."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.observability import profiler
+from ray_tpu.utils.config import config
+
+
+@pytest.fixture(scope="module")
+def rt():
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+# -- classification units ---------------------------------------------------
+
+RT = "/opt/x/ray_tpu/"
+
+
+@pytest.mark.parametrize("leaf,expected", [
+    (RT + "serve/llm.py", "engine"),
+    (RT + "serve/router.py", "serve"),
+    (RT + "collective/nccl_group.py", "collective"),
+    (RT + "parallel/pipeline.py", "pipeline"),
+    (RT + "data/dataset.py", "pipeline"),
+    (RT + "core/object_store.py", "object-store"),
+    (RT + "utils/serialization.py", "object-store"),
+    (RT + "core/control_store.py", "scheduler"),
+    (RT + "core/scheduling.py", "scheduler"),
+    (RT + "utils/rpc.py", "rpc"),
+    (RT + "dashboard.py", "rpc"),
+    (RT + "observability/tracing.py", "obs"),
+    (RT + "core/worker.py", "user"),  # catch-all ray_tpu bucket
+])
+def test_classify_frame_buckets(leaf, expected):
+    assert profiler.classify_frames([leaf]) == expected
+
+
+def test_classify_skips_stdlib_to_find_ray_tpu_frame():
+    # leaf blocked in stdlib (threading.wait) but called FROM rpc code:
+    # attribution must walk rootward past stdlib frames
+    import sysconfig
+
+    stdlib = sysconfig.get_paths()["stdlib"]
+    stack = [
+        stdlib + "/threading.py",
+        stdlib + "/threading.py",
+        RT + "utils/rpc.py",
+        "<string>",
+    ]
+    assert profiler.classify_frames(stack) == "rpc"
+
+
+def test_classify_user_file_wins():
+    assert profiler.classify_frames(["/home/me/train.py"]) == "user"
+
+
+def test_classify_thread_name_fallback():
+    import sysconfig
+
+    stdlib = sysconfig.get_paths()["stdlib"]
+    all_stdlib = [stdlib + "/threading.py", stdlib + "/selectors.py"]
+    assert profiler.classify_frames(
+        all_stdlib, thread_name="cs-heartbeat"
+    ) == "scheduler"
+    # dispatcher threads ({name}-disp-N) are rpc, whatever the owner
+    assert profiler.classify_frames(
+        all_stdlib, thread_name="cs-dispatch-3"
+    ) == "rpc"
+    assert profiler.classify_frames(
+        all_stdlib, thread_name="llm-engine"
+    ) == "engine"
+    assert profiler.classify_frames(all_stdlib, thread_name="") == "other"
+
+
+def test_sample_stacks_sees_this_thread():
+    evt = threading.Event()
+
+    def parked_in_rpcish():
+        evt.wait(5.0)
+
+    th = threading.Thread(
+        target=parked_in_rpcish, name="probe-thread", daemon=True
+    )
+    th.start()
+    try:
+        time.sleep(0.05)
+        stacks = profiler.sample_stacks()
+        mine = [s for s, _sub in stacks if s.startswith("probe-thread;")]
+        assert mine, "probe thread missing from the snapshot"
+        assert "parked_in_rpcish" in mine[0]
+    finally:
+        evt.set()
+        th.join()
+
+
+# -- capture / merge --------------------------------------------------------
+
+def test_capture_and_duration_clamp():
+    # capture excludes the capturing thread itself, so give it a
+    # neighbour to sample (a bare pytest process may be single-threaded)
+    stop = threading.Event()
+    th = threading.Thread(target=stop.wait, args=(10.0,), daemon=True)
+    th.start()
+    try:
+        prof = profiler.capture(duration_s=0.3, hz=200.0)
+        assert prof["samples"] > 0 and prof["ticks"] > 0
+        assert prof["token"] and prof["pid"]
+        assert sum(prof["subsystems"].values()) == prof["samples"]
+        # server-side cap: a hostile duration is clamped, never honored
+        old = config.profiler_max_duration_s
+        config.set("profiler_max_duration_s", 0.2)
+        try:
+            t0 = time.monotonic()
+            clamped = profiler.capture(duration_s=3600.0, hz=50.0)
+            assert time.monotonic() - t0 < 2.0
+            assert clamped["duration_s"] == pytest.approx(0.2)
+        finally:
+            config.set("profiler_max_duration_s", old)
+    finally:
+        stop.set()
+        th.join()
+
+
+def test_merge_dedups_by_process_token():
+    p = {"token": "t1", "pid": 1, "samples": 10, "ticks": 5,
+         "folded": {"a;b": 10}, "subsystems": {"rpc": 10}}
+    q = {"token": "t2", "pid": 2, "samples": 4, "ticks": 2,
+         "folded": {"a;b": 4}, "subsystems": {"user": 4}}
+    merged = profiler.merge([p, dict(p), q, None])
+    assert merged["processes"] == 2
+    assert merged["samples"] == 14
+    assert merged["folded"]["a;b"] == 14
+    assert merged["subsystems"] == {"rpc": 10, "user": 4}
+
+
+def test_folded_text_and_table_rendering():
+    folded = {"main;ray_tpu/utils/rpc:call": 7, "w;user_fn": 3}
+    text = profiler.folded_text(folded)
+    assert text.splitlines()[0] == "main;ray_tpu/utils/rpc:call 7"
+    table = profiler.subsystem_table({"rpc": 70, "user": 30})
+    assert "SUBSYSTEM" in table and "70.0%" in table and "rpc" in table
+    assert profiler.subsystem_table({}) == "(no samples)"
+
+
+def test_flamegraph_html_self_contained():
+    folded = {
+        "main;app:outer;app:inner": 60,
+        "main;app:outer;app:other": 40,
+    }
+    page = profiler.flamegraph_html(folded, title="t<est>")
+    assert page.startswith("<!doctype html>")
+    assert "t&lt;est&gt;" in page  # title escaped
+    assert page.count('<div class="f"') >= 3  # outer + 2 kids
+    assert "http" not in page.split("</title>")[1]  # no external fetches
+    # width of the root frame spans the whole graph
+    assert "width:100.000%" in page
+
+
+# -- fleet capture under streaming serve load -------------------------------
+
+def test_fleet_profile_under_serve_load(rt):
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+
+    srv = LLMServer(LLMConfig(model_id="gpt2-tiny", max_batch_size=4))
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            try:
+                for _ in srv({
+                    "prompt_tokens": [1, 2, 3], "max_new_tokens": 24,
+                    "stream": True,
+                }):
+                    pass
+            except RuntimeError:
+                return  # engine unloaded at test teardown
+
+    pumps = [threading.Thread(target=pump, daemon=True) for _ in range(2)]
+    for th in pumps:
+        th.start()
+    try:
+        merged = state.profile(duration_s=1.5, hz=60.0)
+    finally:
+        stop.set()
+        srv._stop.set()
+        for th in pumps:
+            th.join(timeout=10)
+    assert merged["replies"] >= 1
+    assert merged["processes"] >= 1
+    total = sum(merged["subsystems"].values())
+    assert total > 0
+    attributed = total - merged["subsystems"].get("other", 0)
+    share = attributed / total
+    assert share >= 0.90, (
+        f"only {share:.1%} of samples attributed: {merged['subsystems']}"
+    )
+    # the streaming engine must actually show up in the split
+    assert merged["subsystems"].get("engine", 0) > 0, merged["subsystems"]
+    # folded stacks name real frames fleet-wide
+    assert any("ray_tpu/" in stack for stack in merged["folded"])
+
+
+def test_cli_profile_writes_artifacts(rt, tmp_path, capsys):
+    from ray_tpu import cli
+    from ray_tpu.core import worker as worker_mod
+
+    addr = worker_mod.global_worker().control_address
+    folded_path = tmp_path / "p.folded"
+    html_path = tmp_path / "p.html"
+    rc = cli.main([
+        "--address", addr, "profile", "--duration", "0.5", "--hz", "50",
+        "--out", str(folded_path), "--html", str(html_path),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SUBSYSTEM" in out and "processes" in out
+    folded = folded_path.read_text()
+    assert folded and all(
+        ln.rsplit(" ", 1)[1].isdigit() for ln in folded.splitlines()
+    )
+    assert html_path.read_text().startswith("<!doctype html>")
+
+
+def test_dashboard_profile_and_stacks_routes(rt):
+    import json as json_mod
+
+    from ray_tpu.core import worker as worker_mod
+    from ray_tpu.dashboard import Dashboard
+
+    d = Dashboard(worker_mod.global_worker().control_address)
+    try:
+        status, ctype, body = d._route("/api/profile?duration_s=0.3&hz=50")
+        assert status == 200 and ctype == "application/json"
+        prof = json_mod.loads(body)
+        assert prof["samples"] > 0 and prof["subsystems"]
+        status, _, body = d._route("/api/stacks")
+        assert status == 200
+        dumps = json_mod.loads(body)
+        assert dumps and all("threads" in rec for rec in dumps)
+        status, _, body = d._route("/api/crash_reports")
+        assert status == 200
+        assert isinstance(json_mod.loads(body), list)
+    finally:
+        d._server.server_close()
+
+
+# -- continuous mode --------------------------------------------------------
+
+def test_continuous_sampler_lifecycle():
+    assert profiler.maybe_start_continuous() is None  # hz defaults to 0
+    old = config.profiler_hz
+    config.set("profiler_hz", 50.0)
+    try:
+        sampler = profiler.maybe_start_continuous()
+        assert sampler is not None
+        assert sampler.name == profiler.SAMPLER_THREAD_NAME
+        # idempotent: a second call returns the live singleton
+        assert profiler.maybe_start_continuous() is sampler
+        time.sleep(0.3)
+        st = profiler.continuous_status()
+        assert st["running"] and st["samples"] > 0
+        assert st["duty_pct"] < 50.0  # sanity, not the bench contract
+    finally:
+        profiler.stop_continuous()
+        config.set("profiler_hz", old)
+    assert profiler.continuous_status() == {"running": False, "hz": 0.0}
+
+
+def test_continuous_sampler_respects_kill_switch():
+    old_hz = config.profiler_hz
+    config.set("profiler_hz", 50.0)
+    profiler.set_enabled(False)
+    try:
+        assert profiler.maybe_start_continuous() is None
+        assert profiler.continuous_status() == {"running": False, "hz": 0.0}
+    finally:
+        profiler.set_enabled(True)
+        config.set("profiler_hz", old_hz)
+
+
+def test_continuous_sampler_feeds_subsystem_counter():
+    from ray_tpu.observability import core_metrics
+    from ray_tpu.utils import metrics as metrics_mod
+
+    old = config.profiler_hz
+    config.set("profiler_hz", 100.0)
+    try:
+        profiler.maybe_start_continuous()
+        deadline = time.monotonic() + 5.0
+        total = 0.0
+        while time.monotonic() < deadline:
+            snap = metrics_mod.snapshot_all().get(
+                "rt_profile_samples_total", {}
+            )
+            total = sum(snap.get("series", {}).values())
+            if total > 0:
+                break
+            time.sleep(0.05)
+        assert total > 0, "continuous sampler stamped no samples"
+        assert core_metrics.profiler_continuous_hz is not None
+    finally:
+        profiler.stop_continuous()
+        config.set("profiler_hz", old)

@@ -16,7 +16,10 @@ Flags:
    lexically inside an ``if`` whose test mentions
    ``core_metrics.ENABLED``;
 2. ``tracing.emit(...)`` and ``*._append_task_event(...)`` calls not
-   inside an ``if`` mentioning ``tracing.ENABLED``.
+   inside an ``if`` mentioning ``tracing.ENABLED``;
+3. ``profiler.stamp_*(...)`` / ``forensics.stamp_*(...)`` calls (the
+   profiler/hang-forensics event stampers) not inside an ``if``
+   mentioning that module's ``ENABLED``.
 
 Compound tests count, as does the early-return form (``if not
 mod.ENABLED: return``).  The observability package itself is exempt.  A
@@ -34,7 +37,12 @@ from typing import Iterable, List, Optional, Set, Tuple
 from tools.rtlint.engine import FileContext, LintPass
 
 # Observability modules whose ENABLED flag is a recognised guard.
-MODULES = {"core_metrics", "tracing"}
+MODULES = {"core_metrics", "tracing", "profiler", "forensics"}
+
+# Modules whose ``stamp_*`` helpers are themselves stamp sites (they
+# build event dicts and touch time/ring state before their internal
+# gates — callers must not pay that with the kill switch off).
+STAMP_MODULES = {"profiler", "forensics"}
 
 # Instrument recording methods (utils/metrics.py primitives).
 RECORD_METHODS = {"inc", "set", "observe"}
@@ -79,6 +87,12 @@ def _required_guard(call: ast.Call) -> Optional[str]:
         return "tracing"
     if func.attr == "_append_task_event":
         return "tracing"
+    if (
+        func.attr.startswith("stamp_")
+        and isinstance(func.value, ast.Name)
+        and func.value.id in STAMP_MODULES
+    ):
+        return func.value.id
     if func.attr in RECORD_METHODS:
         base = func.value
         if (
