@@ -27,9 +27,26 @@ same code path ``rt top`` and the alert engine use) within
 ``max(p95 bucket span, 30% of the larger value, 10 ms)`` — bucket
 interpolation cannot resolve finer than the bucket it lands in.
 
+Legs (``--leg``):
+
+- ``steady`` (default): one Poisson window at ``--rate``.
+- ``swing``: a 10x load swing in thirds — [rate, 10*rate, rate] — against
+  an AUTOSCALING deployment (min 1, max ``--replicas``). A background
+  sampler records the replica trajectory (running/target/draining each
+  second) and every autoscale decision; the row carries per-phase client
+  TTFT so the question "did the autoscaler hold p95 through the swing?"
+  is answerable from BENCH_SERVE.json alone.
+- ``overload``: arrivals at 10x ``--rate`` against a deployment whose
+  proxy admission bound (``--max-queued``) is far below capacity: the
+  surplus must shed CLEANLY — instant unary 429/503 + Retry-After,
+  counted client-side (``shed_503``/``shed_429``) and server-side
+  (``rt_serve_shed_total`` delta), with zero client hangs.
+
 Every run appends one row to BENCH_SERVE.json.
 
 Run: python bench_serve.py --rate 30 --duration 20
+     python bench_serve.py --leg swing --rate 2 --duration 60
+     python bench_serve.py --leg overload --rate 3 --duration 15
 """
 
 import argparse
@@ -136,13 +153,51 @@ def _sum_ttft_hist(mx):
     return bounds, (buckets or []), count
 
 
+def _autoscale_sampler(stop, out, deployment):
+    """1 Hz recorder of the serve control loop: replica trajectory +
+    every distinct autoscale decision (deduped by decision timestamp)."""
+    from ray_tpu import serve
+
+    seen = set()
+    while not stop.wait(1.0):
+        try:
+            st = serve.autoscale_status().get(deployment)
+        except Exception:  # noqa: BLE001 — controller restarting
+            continue
+        if not st:
+            continue
+        out["trajectory"].append({
+            "t": round(time.perf_counter() - out["t0"], 1),
+            "running": st["running"],
+            "target": st["target"],
+            "draining": len(st["draining"] or {}),
+        })
+        dec = st.get("last_decision")
+        if dec and dec.get("ts") not in seen:
+            seen.add(dec.get("ts"))
+            out["decisions"].append(dict(dec))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--leg", choices=("steady", "swing", "overload"),
+                    default="steady",
+                    help="load shape: one rate, a 10x swing against an "
+                         "autoscaling deployment, or sustained overload "
+                         "against a tight admission bound")
     ap.add_argument("--rate", type=float, default=30.0,
-                    help="mean arrival rate, requests/s (Poisson)")
+                    help="mean arrival rate, requests/s (Poisson); the "
+                         "swing/overload legs burst at 10x this")
     ap.add_argument("--duration", type=float, default=20.0,
                     help="load window, seconds")
-    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fixed replica count (steady/overload); the "
+                         "autoscaler's max_replicas on the swing leg")
+    ap.add_argument("--max-queued", type=int, default=8,
+                    help="overload leg: per-deployment proxy admission "
+                         "bound (max_queued_requests)")
+    ap.add_argument("--target-ongoing", type=int, default=4,
+                    help="swing leg: autoscaler target_ongoing_requests")
     ap.add_argument("--max-batch-size", type=int, default=4)
     ap.add_argument("--max-tokens", type=int, default=16,
                     help="tokens generated per request")
@@ -188,12 +243,30 @@ def main() -> int:
     ray_tpu.init(num_cpus=max(8, args.replicas * 2))
     serve.start(http_port=0)
     try:
+        deploy_kwargs = {}
+        if args.leg == "swing":
+            # the swing leg measures the CONTROL LOOP: start at one
+            # replica and let the SLO policy ride the 10x burst
+            deploy_kwargs = {
+                "num_replicas": 1,
+                "autoscaling_config": {
+                    "min_replicas": 1,
+                    "max_replicas": args.replicas,
+                    "target_ongoing_requests": args.target_ongoing,
+                },
+            }
+        elif args.leg == "overload":
+            deploy_kwargs = {
+                "num_replicas": args.replicas,
+                "max_queued_requests": args.max_queued,
+            }
+        else:
+            deploy_kwargs = {"num_replicas": args.replicas}
         serve_llm.deploy(
             {MODEL: serve_llm.LLMConfig(
                 model_id="gpt2-tiny", max_batch_size=args.max_batch_size,
             )},
-            name=DEPLOYMENT, num_replicas=args.replicas,
-            route_prefix="/v1",
+            name=DEPLOYMENT, route_prefix="/v1", **deploy_kwargs,
         )
         deadline = time.monotonic() + 60
         addrs = []
@@ -210,15 +283,31 @@ def main() -> int:
             for _ in range(args.replicas):
                 _stream_one(host, port, n, 4, args.timeout)
 
-        # ---- measured window: open-loop Poisson arrivals ----
+        # ---- measured window: open-loop Poisson arrivals, piecewise
+        # per leg: steady [r], swing [r, 10r, r], overload [10r] ----
+        if args.leg == "swing":
+            third = args.duration / 3.0
+            phases = [(args.rate, third), (10.0 * args.rate, third),
+                      (args.rate, third)]
+        elif args.leg == "overload":
+            phases = [(10.0 * args.rate, args.duration)]
+        else:
+            phases = [(args.rate, args.duration)]
         arrivals = []
-        t = 0.0
-        while t < args.duration:
-            t += rng.expovariate(args.rate)
-            if t < args.duration:
-                arrivals.append(t)
+        offset = 0.0
+        for rate, dur in phases:
+            t = 0.0
+            while True:
+                t += rng.expovariate(rate)
+                if t >= dur:
+                    break
+                arrivals.append(offset + t)
+            offset += dur
         mx0 = state.cluster_metrics()
         b0, k0, c0 = _sum_ttft_hist(mx0)
+        shed0 = sum(
+            (mx0.get("rt_serve_shed_total") or {}).get("series", {}).values()
+        )
 
         results = []
         results_lock = threading.Lock()
@@ -226,15 +315,28 @@ def main() -> int:
         shed = 0
         threads = []
 
-        def worker(prompt_len):
+        def worker(at, prompt_len):
             try:
                 rec = _stream_one(
                     host, port, prompt_len, args.max_tokens, args.timeout
                 )
             finally:
                 inflight.release()
+            rec["at"] = at  # arrival time: phase attribution in rollup
             with results_lock:
                 results.append(rec)
+
+        sampler_stop = threading.Event()
+        sampler_out = None
+        if args.leg == "swing":
+            sampler_out = {
+                "t0": time.perf_counter(), "trajectory": [], "decisions": [],
+            }
+            threading.Thread(
+                target=_autoscale_sampler,
+                args=(sampler_stop, sampler_out, DEPLOYMENT),
+                daemon=True,
+            ).start()
 
         bench_t0 = time.perf_counter()
         for at in arrivals:
@@ -246,21 +348,34 @@ def main() -> int:
                 continue
             th = threading.Thread(
                 target=worker,
-                args=(_sample_prompt_len(
+                args=(at, _sample_prompt_len(
                     rng, args.prompt_median, args.prompt_sigma,
                     args.prompt_cap,
-                ),),
+                )),
                 daemon=True,
             )
             th.start()
             threads.append(th)
+        hung = 0
         for th in threads:
             th.join(timeout=args.timeout + 30)
+            hung += th.is_alive()
         wall_s = time.perf_counter() - bench_t0
+        sampler_stop.set()
 
         # ---- client-side rollup ----
         ok = [r for r in results if r.get("ok")]
-        errors = [r for r in results if not r.get("ok")]
+        shed_429 = sum(
+            1 for r in results if r.get("error") == "http 429"
+        )
+        shed_503 = sum(
+            1 for r in results if r.get("error") == "http 503"
+        )
+        errors = [
+            r for r in results
+            if not r.get("ok")
+            and r.get("error") not in ("http 429", "http 503")
+        ]
         ttfts = sorted(r["ttft"] for r in ok)
         e2es = sorted(r["e2e"] for r in ok)
         itls = sorted(g for r in ok for g in r["itls"])
@@ -288,10 +403,33 @@ def main() -> int:
             a["name"] for a in alerts_rep.get("alerts", ())
             if a.get("state") == "firing"
         ]
+        mx_shed = state.cluster_metrics().get("rt_serve_shed_total") or {}
+        server_shed = sum(mx_shed.get("series", {}).values()) - shed0
+
+        # per-phase TTFT: the swing question is "did p95 hold through
+        # the 10x burst", answered by attributing each ok request to the
+        # phase its ARRIVAL fell in
+        phase_stats = []
+        if len(phases) > 1:
+            start = 0.0
+            for rate, dur in phases:
+                end = start + dur
+                sub = sorted(
+                    r["ttft"] for r in ok if start <= r.get("at", 0.0) < end
+                )
+                p50, p95 = _percentile(sub, 0.50), _percentile(sub, 0.95)
+                phase_stats.append({
+                    "rate_rps": rate,
+                    "requests_ok": len(sub),
+                    "ttft_p50_ms": round(p50 * 1e3, 1) if p50 else None,
+                    "ttft_p95_ms": round(p95 * 1e3, 1) if p95 else None,
+                })
+                start = end
 
         row = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "host": host_meta,
+            "leg": args.leg,
             "rate_rps": args.rate,
             "duration_s": args.duration,
             "replicas": args.replicas,
@@ -302,6 +440,9 @@ def main() -> int:
             "requests": {
                 "scheduled": len(arrivals), "ok": len(ok),
                 "errors": len(errors), "shed": shed,
+                "shed_429": shed_429, "shed_503": shed_503,
+                "server_shed": round(server_shed, 0),
+                "hung_clients": hung,
             },
             "goodput_rps": round(len(ok) / wall_s, 2),
             "tokens_per_s": round(tokens / wall_s, 1),
@@ -326,6 +467,17 @@ def main() -> int:
             },
             "alerts_firing": firing,
         }
+        if phase_stats:
+            row["phases"] = phase_stats
+        if sampler_out is not None:
+            traj = sampler_out["trajectory"]
+            row["autoscale"] = {
+                "peak_replicas": max(
+                    (p["running"] for p in traj), default=0
+                ),
+                "decisions": sampler_out["decisions"],
+                "trajectory": traj,
+            }
         print(json.dumps(row, indent=2))
 
         doc = {"schema": 1, "rows": []}

@@ -108,13 +108,17 @@ def _top_history(state_mod, addr, since: float, deps: List[str]):
 
 def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
                 alerts_rep: Optional[dict] = None,
-                hist: Optional[dict] = None) -> str:
+                hist: Optional[dict] = None,
+                ascale: Optional[dict] = None) -> str:
     """One `rt top` frame from a state.cluster_metrics() aggregate and a
     state.request_summary() rollup. ``qps`` maps deployment -> req/s
     computed by the caller from successive router-counter frames (None
     on the first frame / --once). ``alerts_rep`` / ``hist`` (state.alerts
     and the metrics-history view) add the FIRING banner and the windowed
-    sparkline/percentile columns when the head-side sampler is on."""
+    sparkline/percentile columns when the head-side sampler is on.
+    ``ascale`` (state.autoscale_status) adds the control-loop columns:
+    replicas as running/target(+Nd draining), shed counts, and the last
+    autoscale decision with its reason."""
 
     def metric(name: str) -> dict:
         return mx.get(name) or {"series": {}, "tag_keys": ()}
@@ -222,13 +226,31 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
         total = hits.get(dep, 0.0) + misses.get(dep, 0.0)
         if total:
             row(dep)["cache_hit"] = f"{100.0 * hits.get(dep, 0.0) / total:.0f}%"
+    for dep, v in by_tag("rt_serve_shed_total", "deployment").items():
+        if v:
+            row(dep)["shed"] = int(v)
+    for dep, st in (ascale or {}).items():
+        r = row(dep)
+        running = st.get("running", 0)
+        target = st.get("target", 0)
+        draining = len(st.get("draining") or {})
+        rep = f"{running}/{target}"
+        if draining:
+            rep += f"(+{draining}d)"
+        r["replicas"] = rep
+        dec = st.get("last_decision") or {}
+        if dec.get("direction") in ("up", "down"):
+            r["last_scale"] = (
+                f"{dec['direction']} {dec.get('from', '?')}->"
+                f"{dec.get('to', '?')} {dec.get('reason', '')}"
+            ).strip()
     for dep, r in rows.items():
         r["qps"] = (
             f"{qps.get(dep, 0.0):.1f}" if qps is not None else "-"
         )
-    columns = ["deployment", "reqs", "qps", "ttft_p50_ms", "ttft_p95_ms",
-               "itl_p50_ms", "tokens", "kv_slots", "queued", "batch_fill",
-               "cache_hit"]
+    columns = ["deployment", "replicas", "reqs", "qps", "ttft_p50_ms",
+               "ttft_p95_ms", "itl_p50_ms", "tokens", "kv_slots", "queued",
+               "shed", "batch_fill", "cache_hit", "last_scale"]
     if hist is not None:
         # windowed view from the history store: TTFT p95 over the last
         # --since seconds (not since boot) + a QPS sparkline
@@ -675,6 +697,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             except (RemoteError, RuntimeError):
                 alerts_rep = {"enabled": False, "alerts": []}
             hist = _top_history(state, addr, args.since, _router_deps(mx))
+            try:
+                ascale = state.autoscale_status(addr)
+            except Exception:  # noqa: BLE001 — no serve controller
+                ascale = {}
             if args.as_json:
                 return mx, json.dumps(
                     {"metrics": {
@@ -682,10 +708,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                             ",".join(k): v for k, v in m["series"].items()
                         }) for name, m in mx.items()
                     }, "requests": reqs, "alerts": alerts_rep,
-                        "history": hist}, indent=2, default=str,
+                        "history": hist, "autoscale": ascale},
+                    indent=2, default=str,
                 )
             return mx, _render_top(mx, reqs, qps, alerts_rep=alerts_rep,
-                                   hist=hist)
+                                   hist=hist, ascale=ascale)
 
         if args.once:
             print(frame(None)[1])

@@ -134,6 +134,15 @@ def default_rules() -> List[Rule]:
             threshold=float(config.alerts_kv_occupancy_frac),
             window_s=max(for_s, 10.0), agg="avg", for_s=for_s,
         ),
+        # Admission control shedding faster than clients should retry:
+        # sustained 429/503 volume means capacity, caps, or the
+        # autoscaler max bound need attention.
+        Rule(
+            name="serve_shed_rate", kind="threshold",
+            metric="rt_serve_shed_total", op=">",
+            threshold=float(config.alerts_shed_rate_max),
+            window_s=max(for_s, 10.0), for_s=for_s,
+        ),
         # Observability self-check: ring evictions mean truncated
         # timelines and undercounted percentiles.
         Rule(

@@ -261,6 +261,40 @@ def _build() -> dict:
             "KV-cache slot capacity (max_batch_size) per engine process",
             tag_keys=("deployment", "node"),
         ),
+        # -- serving control loop (serve/autoscale/) --
+        "serve_shed": Counter(
+            "rt_serve_shed_total",
+            "requests shed by proxy admission control (429/503 + "
+            "Retry-After), by deployment and reason",
+            tag_keys=("deployment", "reason"),
+        ),
+        "serve_admission_inflight": Gauge(
+            "rt_serve_admission_inflight",
+            "requests currently admitted (queued + executing) through "
+            "this proxy, per deployment",
+            tag_keys=("deployment", "node"),
+        ),
+        "serve_replicas_running": Gauge(
+            "rt_serve_replicas_running",
+            "serving replicas currently live per deployment",
+            tag_keys=("deployment",),
+        ),
+        "serve_replicas_target": Gauge(
+            "rt_serve_replicas_target",
+            "autoscaler target replica count per deployment",
+            tag_keys=("deployment",),
+        ),
+        "serve_replicas_draining": Gauge(
+            "rt_serve_replicas_draining",
+            "replicas in session-aware drain (out of the routing table, "
+            "finishing live streams) per deployment",
+            tag_keys=("deployment",),
+        ),
+        "serve_autoscale_decisions": Counter(
+            "rt_serve_autoscale_decisions_total",
+            "autoscaler scale decisions by deployment and direction",
+            tag_keys=("deployment", "direction"),
+        ),
     }
 
 

@@ -9,11 +9,13 @@ from ray_tpu.serve.api import (
     DeploymentResponse,
     Deployment,
     DeploymentHandle,
+    autoscale_status,
     delete,
     deployment,
     get_deployment_handle,
     proxy_addresses,
     run,
+    scale,
     shutdown,
     start,
     status,
@@ -35,6 +37,7 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "Request",
+    "autoscale_status",
     "batch",
     "delete",
     "deployment",
@@ -42,6 +45,7 @@ __all__ = [
     "multiplexed",
     "proxy_addresses",
     "run",
+    "scale",
     "shutdown",
     "start",
     "status",

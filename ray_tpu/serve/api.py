@@ -28,6 +28,7 @@ class Deployment:
         max_concurrency: int = 8,
         autoscaling_config: Optional[Dict[str, Any]] = None,
         ray_actor_options: Optional[Dict[str, float]] = None,
+        max_queued_requests: Optional[int] = None,
     ):
         self.func_or_class = func_or_class
         self.name = name
@@ -36,6 +37,9 @@ class Deployment:
         self.max_concurrency = max_concurrency
         self.autoscaling_config = autoscaling_config
         self.ray_actor_options = ray_actor_options
+        # per-deployment proxy admission bound (in-flight requests per
+        # proxy; None = RT_SERVE_ADMISSION_MAX_INFLIGHT)
+        self.max_queued_requests = max_queued_requests
         self.init_args: tuple = ()
         self.init_kwargs: dict = {}
 
@@ -43,7 +47,7 @@ class Deployment:
         clone = Deployment(
             self.func_or_class, self.name, self.num_replicas,
             self.route_prefix, self.max_concurrency, self.autoscaling_config,
-            self.ray_actor_options,
+            self.ray_actor_options, self.max_queued_requests,
         )
         clone.init_args = args
         clone.init_kwargs = kwargs
@@ -67,6 +71,7 @@ def deployment(
     max_concurrency: int = 8,
     autoscaling_config: Optional[Dict[str, Any]] = None,
     ray_actor_options: Optional[Dict[str, float]] = None,
+    max_queued_requests: Optional[int] = None,
 ):
     """@serve.deployment decorator (reference api.py deployment)."""
 
@@ -79,6 +84,7 @@ def deployment(
             max_concurrency=max_concurrency,
             autoscaling_config=autoscaling_config,
             ray_actor_options=ray_actor_options,
+            max_queued_requests=max_queued_requests,
         )
 
     if _func_or_class is not None:
@@ -114,16 +120,30 @@ def run(dep: Deployment, *, wait_ready: bool = True,
             dep.name, blob, dep.init_args, dep.init_kwargs,
             dep.num_replicas, dep.route_prefix, dep.max_concurrency,
             dep.autoscaling_config, dep.ray_actor_options,
+            dep.max_queued_requests,
         )
     )
-    if wait_ready:
-        ok = ray_tpu.get(
-            controller.ready.remote(dep.name, ready_timeout_s),
-            timeout=ready_timeout_s + 30,
-        )
-        if not ok:
-            raise TimeoutError(f"deployment {dep.name!r} did not become ready")
+    if wait_ready and not _wait_ready(controller, dep.name, ready_timeout_s):
+        raise TimeoutError(f"deployment {dep.name!r} did not become ready")
     return DeploymentHandle(dep.name)
+
+
+def _wait_ready(controller, name: str, timeout_s: float) -> bool:
+    """Client side of the sliced controller.ready(): the controller
+    answers each call within config.dispatch_wait_slice_s (dispatcher-
+    block discipline), so the client re-issues slices until its own
+    deadline."""
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return False
+        if ray_tpu.get(
+            controller.ready.remote(name, left), timeout=left + 30
+        ):
+            return True
 
 
 class DeploymentResponse:
@@ -227,6 +247,31 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
 def status() -> Dict[str, Any]:
     controller = start()
     return ray_tpu.get(controller.status.remote())
+
+
+def scale(name: str, num_replicas: int,
+          drain_deadline_s: Optional[float] = None) -> bool:
+    """Manually set a deployment's target replica count. Scale-down is
+    session-aware: surplus replicas drain (no new sessions, live SSE
+    streams finish) and exit, force-killed only at ``drain_deadline_s``
+    (default RT_SERVE_AUTOSCALE_DRAIN_DEADLINE_S). On an autoscaling
+    deployment the policy re-evaluates from the new target next tick."""
+    controller = start()
+    return ray_tpu.get(
+        controller.set_target_replicas.remote(
+            name, num_replicas, drain_deadline_s
+        )
+    )
+
+
+def autoscale_status() -> Dict[str, Any]:
+    """Live control-loop state straight from the controller: replica
+    counts (target/running/draining with per-drainer progress), the last
+    scale decision, and the signals behind it. `state.autoscale_status()`
+    reads the same snapshot from the head KV without needing the
+    controller handle."""
+    controller = start()
+    return ray_tpu.get(controller.autoscale_status.remote())
 
 
 def delete(name: str) -> None:

@@ -3,15 +3,24 @@
 Parity: the reference ServeController actor
 (python/ray/serve/_private/controller.py:123) with its
 DeploymentStateManager reconcile loop (deployment_state.py:2203,3627),
-requests-per-replica autoscaling (autoscaling_policy.py), and replica
-health checking. Routing tables are served with a version number so
-routers poll cheaply (long-poll-lite, reference long_poll.py:253).
+SLO-driven autoscaling (serve/autoscale/policy.py replaces the naive
+requests-per-replica count), and replica health checking. Routing
+tables are served with a version number so routers poll cheaply
+(long-poll-lite, reference long_poll.py:253).
+
+Scale-down is session-aware: a victim replica moves to the
+deployment's ``draining`` set — out of the routing table (the HRW
+session router re-pins its sessions to survivors on the next refresh)
+but still probed — and is killed only once its in-flight work,
+streaming included, hits zero (plus a settle period covering requests
+already routed) or the drain deadline fires.
 """
 
 from __future__ import annotations
 
+import json
 import logging
-import math
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -23,7 +32,11 @@ logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 RECONCILE_PERIOD_S = 0.5
-AUTOSCALE_WINDOW_S = 2.0
+# A drained replica must stay up at least this long after leaving the
+# table: routers refresh within ROUTE_REFRESH_S (1 s) and requests they
+# routed in the stale window still have to land and count in the next
+# health probe before "ongoing == 0" means quiescent.
+DRAIN_SETTLE_S = 2.0
 
 
 @ray_tpu.remote
@@ -35,6 +48,8 @@ class ServeController:
         self._version = 0
         self._http_port = http_port
         self._proxies: Dict[str, Any] = {}  # node_id -> proxy handle
+        self._policy = None  # SLOPolicy, built lazily on first tick
+        self._collector = None  # SignalCollector, ditto
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True
@@ -56,6 +71,7 @@ class ServeController:
         max_concurrency: int,
         autoscaling: Optional[Dict[str, Any]],
         resources: Optional[Dict[str, float]],
+        max_queued_requests: Optional[int] = None,
     ) -> bool:
         old_replicas = []
         with self._lock:
@@ -68,6 +84,7 @@ class ServeController:
                 # reconciler starts fresh ones from the new blob.
                 next_replica = existing["next_replica"]
                 old_replicas = list(existing["replicas"].values())
+                old_replicas.extend(existing["draining"].values())
             self._deployments[name] = {
                 "name": name,
                 "callable_blob": callable_blob,
@@ -79,12 +96,24 @@ class ServeController:
                 # {min_replicas, max_replicas, target_ongoing_requests}
                 "autoscaling": autoscaling,
                 "resources": resources or {},
+                # per-deployment proxy admission bound (None = global
+                # RT_SERVE_ADMISSION_MAX_INFLIGHT); ships in the routing
+                # table so every proxy enforces it without a config hop
+                "max_queued_requests": max_queued_requests,
                 "replicas": {},  # replica_id -> {handle, healthy}
                 "stats": {},  # replica_id -> last stats
+                # replica_id -> {handle, handle_info, since, deadline,
+                # ongoing}: out of the table, finishing live streams
+                "draining": {},
+                "drain_deadline_s": None,  # per-deployment override
+                "last_decision": None,  # last up/down autoscale decision
+                "last_signals": None,  # most recent Signals.describe()
                 "next_replica": next_replica,
                 "deleting": False,
             }
             self._version += 1
+        if self._policy is not None:
+            self._policy.forget(name)  # fresh hysteresis for new code
         for rec in old_replicas:
             self._kill_silently(rec["handle"])
         return True
@@ -97,6 +126,8 @@ class ServeController:
             dep["deleting"] = True
             dep["target_replicas"] = 0
             self._version += 1
+        if self._policy is not None:
+            self._policy.forget(name)
         return True
 
     def get_routing_table(self, known_version: int = -1, wait_s: float = 0.0):
@@ -104,7 +135,14 @@ class ServeController:
         TOPOLOGY version changes (long-poll-lite). With wait_s == 0 the
         current table is always returned — replica `ongoing` counts change
         continuously without bumping the version, and routers need them
-        fresh (pow-2 would otherwise route on frozen queue lengths)."""
+        fresh (pow-2 would otherwise route on frozen queue lengths).
+
+        The wait is SLICED server-side (dispatcher-block discipline):
+        routers re-issue slices forever (router._topology_longpoll), so a
+        long caller deadline must not hold an actor thread here."""
+        from ray_tpu.utils.config import config
+
+        wait_s = min(wait_s, float(config.dispatch_wait_slice_s))
         deadline = time.monotonic() + wait_s
         while True:
             with self._lock:
@@ -112,6 +150,7 @@ class ServeController:
                     table = {
                         name: {
                             "route_prefix": dep["route_prefix"],
+                            "max_queued_requests": dep["max_queued_requests"],
                             "replicas": [
                                 {
                                     "replica_id": rid,
@@ -143,15 +182,76 @@ class ServeController:
                     "running": sum(
                         1 for r in dep["replicas"].values() if r["healthy"]
                     ),
+                    "draining": len(dep["draining"]),
                     "route_prefix": dep["route_prefix"],
                     "autoscaling": dep["autoscaling"],
+                    "last_decision": dep["last_decision"],
                 }
                 for name, dep in self._deployments.items()
             }
 
+    def set_target_replicas(
+        self,
+        name: str,
+        num_replicas: int,
+        drain_deadline_s: Optional[float] = None,
+    ) -> bool:
+        """Manual scale (`serve.scale`). On an autoscaling deployment the
+        policy re-evaluates from here next tick; on a manual one this IS
+        the desired state. ``drain_deadline_s`` overrides the
+        RT_SERVE_AUTOSCALE_DRAIN_DEADLINE_S force-kill bound for this
+        deployment's subsequent drains."""
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is None or dep["deleting"]:
+                return False
+            old = dep["target_replicas"]
+            dep["target_replicas"] = max(0, int(num_replicas))
+            if drain_deadline_s is not None:
+                dep["drain_deadline_s"] = float(drain_deadline_s)
+            new = dep["target_replicas"]
+        if new != old:
+            direction = "up" if new > old else "down"
+            self._record_decision(name, old, new, direction, "manual")
+        return True
+
+    def autoscale_status(self) -> Dict[str, Any]:
+        """Control-loop visibility (`state.autoscale_status`, `rt top`):
+        per-deployment replica counts, drain progress, the last scale
+        decision and the signals behind it."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                name: {
+                    "target": dep["target_replicas"],
+                    "running": sum(
+                        1 for r in dep["replicas"].values() if r["healthy"]
+                    ),
+                    "draining": {
+                        rid: {
+                            "ongoing": rec["ongoing"],
+                            "age_s": round(now - rec["since"], 3),
+                            "deadline_in_s": round(rec["deadline"] - now, 3),
+                        }
+                        for rid, rec in dep["draining"].items()
+                    },
+                    "autoscaling": dep["autoscaling"],
+                    "last_decision": dep["last_decision"],
+                    "last_signals": dep["last_signals"],
+                }
+                for name, dep in self._deployments.items()
+                if not dep["deleting"]
+            }
+
     def ready(self, name: str, timeout_s: float = 60.0) -> bool:
+        """Sliced like get_routing_table: returns False at the slice
+        bound and clients (serve.run) re-issue until their own
+        deadline."""
+        from ray_tpu.utils.config import config
+
+        timeout_s = min(timeout_s, float(config.dispatch_wait_slice_s))
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        while True:
             with self._lock:
                 dep = self._deployments.get(name)
                 if dep is not None:
@@ -160,8 +260,9 @@ class ServeController:
                     )
                     if healthy >= max(1, dep["target_replicas"]):
                         return True
+            if time.monotonic() >= deadline:
+                return False
             time.sleep(0.05)
-        return False
 
     def shutdown(self) -> bool:
         self._stop.set()
@@ -172,6 +273,8 @@ class ServeController:
             self._proxies.clear()
         for dep in deps:
             for rec in dep["replicas"].values():
+                self._kill_silently(rec["handle"])
+            for rec in dep["draining"].values():
                 self._kill_silently(rec["handle"])
         for p in proxies:
             self._kill_silently(p)
@@ -189,13 +292,17 @@ class ServeController:
     # ------------------------------------------------------------------
 
     def _reconcile_loop(self) -> None:
+        from ray_tpu.utils.config import config
+
         last_autoscale = 0.0
         while not self._stop.wait(RECONCILE_PERIOD_S):
             try:
                 self._check_health()
                 now = time.monotonic()
-                if now - last_autoscale >= AUTOSCALE_WINDOW_S:
+                interval = float(config.serve_autoscale_interval_s)
+                if now - last_autoscale >= max(interval, RECONCILE_PERIOD_S):
                     self._autoscale()
+                    self._publish_status()
                     last_autoscale = now
                 self._reconcile()
                 self._ensure_proxies()
@@ -218,11 +325,19 @@ class ServeController:
         w = worker_mod.global_worker()
         with self._lock:
             probes = [
-                (dep, rid, rec)
+                (dep, rid, rec, False)
                 for dep in self._deployments.values()
                 for rid, rec in list(dep["replicas"].items())
             ]
-        for dep, rid, rec in probes:
+            # draining replicas stay probed: "ongoing == 0" is the drain
+            # completion signal, and a drainer that dies mid-drain must
+            # be reaped, not waited on until its deadline
+            probes.extend(
+                (dep, rid, rec, True)
+                for dep in self._deployments.values()
+                for rid, rec in list(dep["draining"].items())
+            )
+        for dep, rid, rec, draining in probes:
             dead = False
             try:
                 addr = w._resolve_actor_address(
@@ -234,6 +349,9 @@ class ServeController:
                 if stats is None:
                     raise RpcConnectionError("worker hosts no actor")
                 with self._lock:
+                    if draining:
+                        rec["ongoing"] = stats["queued"] + stats["running"]
+                        continue
                     dep["stats"][rid] = {
                         "ongoing": stats["queued"] + stats["running"],
                         "model_ids": stats.get("multiplexed_model_ids", []),
@@ -264,65 +382,237 @@ class ServeController:
             if not dead:
                 continue
             with self._lock:
-                if rec["healthy"]:
-                    rec["healthy"] = False
-                self._version += 1
-                dep["replicas"].pop(rid, None)
-                dep["stats"].pop(rid, None)
+                if draining:
+                    dep["draining"].pop(rid, None)
+                else:
+                    if rec["healthy"]:
+                        rec["healthy"] = False
+                    self._version += 1
+                    dep["replicas"].pop(rid, None)
+                    dep["stats"].pop(rid, None)
             self._kill_silently(rec["handle"])
             logger.warning(
-                "replica %s of %s failed health check; removed",
-                rid, dep["name"],
+                "replica %s of %s failed health check; removed%s",
+                rid, dep["name"], " (was draining)" if draining else "",
             )
 
     def _autoscale(self) -> None:
-        """requests-per-replica policy (reference autoscaling_policy.py):
-        desired = ceil(total_ongoing / target_ongoing_requests)."""
+        """SLO-driven policy (serve/autoscale/policy.py): windowed TTFT
+        p95 / KV occupancy / queue depth from the head's metrics history
+        plus the burn-rate alert state, folded over the ongoing-count
+        baseline with hysteresis, cooldowns and min/max bounds. Every
+        up/down decision is stamped as a timeline event, counted in
+        rt_serve_autoscale_decisions_total, and published to the head KV
+        for state.autoscale_status() / `rt top`."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.serve.autoscale.policy import SignalCollector, SLOPolicy
+
+        if self._policy is None:
+            self._policy = SLOPolicy()
+        if self._collector is None:
+            self._collector = SignalCollector(
+                worker_mod.global_worker().control.call
+            )
         with self._lock:
             deps = list(self._deployments.values())
         for dep in deps:
             auto = dep["autoscaling"]
             if not auto or dep["deleting"]:
                 continue
+            name = dep["name"]
             with self._lock:
                 total_ongoing = sum(
                     s.get("ongoing", 0) for s in dep["stats"].values()
                 )
-                target_per = max(1e-9, float(auto.get("target_ongoing_requests", 1)))
-                desired = math.ceil(total_ongoing / target_per)
-                desired = max(int(auto.get("min_replicas", 1)), desired)
-                desired = min(int(auto.get("max_replicas", 8)), desired)
-                if desired != dep["target_replicas"]:
-                    logger.info(
-                        "autoscaling %s: %d -> %d (ongoing=%d)",
-                        dep["name"], dep["target_replicas"], desired,
-                        total_ongoing,
-                    )
-                    dep["target_replicas"] = desired
+                model_ids = sorted({
+                    m
+                    for s in dep["stats"].values()
+                    for m in s.get("model_ids", [])
+                })
+                current = dep["target_replicas"]
+            signals = self._collector.collect(name, model_ids, total_ongoing)
+            decision = self._policy.decide(name, current, signals, auto)
+            with self._lock:
+                # re-read under the lock: a set_target_replicas/redeploy
+                # may have moved the target while signals were collected
+                if self._deployments.get(name) is not dep:
+                    continue
+                dep["last_signals"] = signals.describe()
+                if dep["target_replicas"] != current:
+                    continue
+                if decision.direction == "hold":
+                    continue
+                dep["target_replicas"] = decision.target
+            logger.info(
+                "autoscaling %s: %d -> %d (%s)",
+                name, current, decision.target, decision.reason,
+            )
+            self._record_decision(
+                name, current, decision.target, decision.direction,
+                decision.reason,
+            )
+
+    def _record_decision(
+        self, name: str, old: int, new: int, direction: str, reason: str
+    ) -> None:
+        """One scale decision: dep record (for status), timeline instant
+        (for `rt timeline`), decision counter (for history/alerts)."""
+        from ray_tpu.observability import core_metrics, tracing
+
+        decision = {
+            "from": old, "to": new, "direction": direction,
+            "reason": reason, "ts": time.time(),
+        }
+        with self._lock:
+            dep = self._deployments.get(name)
+            if dep is not None:
+                dep["last_decision"] = decision
+        if tracing.ENABLED:
+            tracing.emit({
+                "type": "autoscale",
+                "deployment": name,
+                "from": old,
+                "to": new,
+                "direction": direction,
+                "reason": reason,
+                "ts_us": tracing.now_us(),
+                "pid": os.getpid(),
+            })
+        if core_metrics.ENABLED:
+            core_metrics.serve_autoscale_decisions.inc(
+                tags={"deployment": name, "direction": direction}
+            )
+
+    def _publish_status(self) -> None:
+        """Replica gauges + the autoscale_status snapshot into the head
+        KV (ns="serve"), the same side channel the cluster autoscaler
+        uses for infeasible demand: state.autoscale_status() and `rt
+        top` read it without an extra controller round-trip."""
+        from ray_tpu.core import worker as worker_mod
+        from ray_tpu.observability import core_metrics
+
+        status = self.autoscale_status()
+        if core_metrics.ENABLED:
+            for name, st in status.items():
+                tags = {"deployment": name}
+                core_metrics.serve_replicas_running.set(
+                    float(st["running"]), tags=tags
+                )
+                core_metrics.serve_replicas_target.set(
+                    float(st["target"]), tags=tags
+                )
+                core_metrics.serve_replicas_draining.set(
+                    float(len(st["draining"])), tags=tags
+                )
+        try:
+            worker_mod.global_worker().control.call(
+                "kv_put", ns="serve", key="autoscale_status",
+                value=json.dumps(  # inband: ok — ~1 KiB status record
+                    {"deployments": status, "ts": time.time()}
+                ).encode(),
+                timeout_s=5.0,
+            )
+        except Exception:  # noqa: BLE001 — status publish must not kill the loop
+            pass
 
     def _reconcile(self) -> None:
-        """Start/stop replicas to match target."""
+        """Start/drain/stop replicas to match target."""
+        from ray_tpu.utils.config import config
+
         with self._lock:
             deps = list(self._deployments.values())
         for dep in deps:
+            now = time.monotonic()
             with self._lock:
                 current = len(dep["replicas"])
                 target = dep["target_replicas"]
                 deleting = dep["deleting"]
+                # scale-up resurrects drainers first: their KV cache and
+                # prefix blocks are hot, and un-draining is free — back
+                # into the table, sessions re-pin to them again
+                while current < target and dep["draining"] and not deleting:
+                    rid, rec = max(
+                        dep["draining"].items(), key=lambda kv: kv[1]["since"]
+                    )
+                    dep["draining"].pop(rid)
+                    dep["replicas"][rid] = {
+                        "handle": rec["handle"],
+                        "handle_info": rec["handle_info"],
+                        "healthy": True,
+                    }
+                    self._version += 1
+                    current += 1
+                    logger.info("replica %s un-drained (scale-up)", rid)
             for _ in range(current, target):
                 self._start_replica(dep)
-            if current > target:
+            if deleting:
+                # teardown is not a drain: delete_deployment means stop
+                # now, streams included (old behavior)
                 with self._lock:
-                    victims = list(dep["replicas"].items())[target - current:]
-                    for rid, rec in victims:
-                        dep["replicas"].pop(rid, None)
-                        dep["stats"].pop(rid, None)
-                    self._version += 1
+                    victims = list(dep["replicas"].items())
+                    victims += list(dep["draining"].items())
+                    dep["replicas"].clear()
+                    dep["draining"].clear()
+                    dep["stats"].clear()
+                    if victims:
+                        self._version += 1
                 for _, rec in victims:
                     self._kill_silently(rec["handle"])
+            elif current > target:
+                with self._lock:
+                    # session-aware drain: victims leave the table
+                    # (routers re-pin within ROUTE_REFRESH_S) but keep
+                    # running until their streams finish. Fewest-ongoing
+                    # first: drains finish fastest and the fewest
+                    # sessions remap.
+                    ranked = sorted(
+                        dep["replicas"].items(),
+                        key=lambda kv: dep["stats"].get(kv[0], {}).get(
+                            "ongoing", 0
+                        ),
+                    )
+                    deadline_s = dep["drain_deadline_s"]
+                    if deadline_s is None:
+                        deadline_s = float(
+                            config.serve_autoscale_drain_deadline_s
+                        )
+                    for rid, rec in ranked[: current - target]:
+                        dep["replicas"].pop(rid, None)
+                        stats = dep["stats"].pop(rid, None) or {}
+                        dep["draining"][rid] = {
+                            "handle": rec["handle"],
+                            "handle_info": rec["handle_info"],
+                            "since": now,
+                            "deadline": now + deadline_s,
+                            "ongoing": stats.get("ongoing", 0),
+                        }
+                        logger.info(
+                            "replica %s draining (ongoing=%d, "
+                            "deadline %.1fs)",
+                            rid, stats.get("ongoing", 0), deadline_s,
+                        )
+                    self._version += 1
+            # drain completion: quiescent (after the settle period that
+            # covers requests routed from a stale table) or past the
+            # deadline — then, and only then, the actor dies
+            finished = []
+            with self._lock:
+                for rid, rec in list(dep["draining"].items()):
+                    if (
+                        rec["ongoing"] <= 0
+                        and now - rec["since"] >= DRAIN_SETTLE_S
+                    ):
+                        finished.append((rid, rec, "drained"))
+                    elif now >= rec["deadline"]:
+                        finished.append((rid, rec, "drain deadline"))
+                for rid, _rec, _why in finished:
+                    dep["draining"].pop(rid, None)
+            for rid, rec, why in finished:
+                self._kill_silently(rec["handle"])
+                logger.info("replica %s stopped (%s)", rid, why)
             if deleting:
                 with self._lock:
-                    empty = not dep["replicas"]
+                    empty = not dep["replicas"] and not dep["draining"]
                     name = dep["name"]
                 if empty:
                     with self._lock:

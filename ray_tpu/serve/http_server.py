@@ -12,6 +12,10 @@ Handlers are plain callables (run in the pool, NOT on the loop):
 
     handler(method, path, query, headers, body)
       -> (status:int, content_type:str, payload:bytes)        # unary
+      -> (status:int, content_type:str, payload:bytes,
+          extra_headers:dict)           # unary with extra response
+                                        # headers (admission control
+                                        # sheds attach Retry-After)
       -> generator yielding bytes                             # streaming
       -> (status:int, content_type:str, generator)            # streaming
                                        with explicit status/content-type
@@ -193,8 +197,14 @@ class AioHttpServer:
                     if not ok:
                         return
                 else:
-                    status, ctype, payload = result
-                    await self._respond(writer, status, ctype, payload, keep)
+                    extra = None
+                    if len(result) == 4:
+                        status, ctype, payload, extra = result
+                    else:
+                        status, ctype, payload = result
+                    await self._respond(
+                        writer, status, ctype, payload, keep, extra
+                    )
                 if not keep:
                     return
         finally:
@@ -219,15 +229,24 @@ class AioHttpServer:
         return method.upper(), target, headers
 
     async def _respond(self, writer, status: int, ctype: str,
-                       payload: bytes, keep: bool) -> None:
+                       payload: bytes, keep: bool,
+                       extra: Optional[Dict[str, str]] = None) -> None:
+        extra_lines = b""
+        if extra:
+            extra_lines = b"".join(
+                b"%s: %s\r\n" % (k.encode("latin-1"), v.encode("latin-1"))
+                for k, v in extra.items()
+            )
         writer.write(
             b"HTTP/1.1 %d %s\r\n"
             b"Content-Type: %s\r\n"
             b"Content-Length: %d\r\n"
+            b"%s"
             b"Connection: %s\r\n\r\n"
             % (
                 status, _REASONS.get(status, b"OK"), ctype.encode(),
-                len(payload), b"keep-alive" if keep else b"close",
+                len(payload), extra_lines,
+                b"keep-alive" if keep else b"close",
             )
         )
         writer.write(payload)
@@ -295,6 +314,7 @@ def _close_gen(gen):
 
 _REASONS = {
     200: b"OK", 400: b"Bad Request", 404: b"Not Found",
-    413: b"Payload Too Large", 431: b"Request Header Fields Too Large",
+    413: b"Payload Too Large", 429: b"Too Many Requests",
+    431: b"Request Header Fields Too Large",
     500: b"Internal Server Error", 503: b"Service Unavailable",
 }

@@ -772,6 +772,7 @@ def deploy(
     max_concurrency: int = 16,
     autoscaling_config: Optional[Dict[str, Any]] = None,
     ray_actor_options: Optional[Dict[str, float]] = None,
+    max_queued_requests: Optional[int] = None,
     wait_ready: bool = True,
     ready_timeout_s: float = 300.0,
     disaggregated: bool = False,
@@ -826,6 +827,7 @@ def deploy(
         autoscaling_config=autoscaling_config,
         ray_actor_options=ray_actor_options,
         prefill_deployment=prefill_name,
+        max_queued_requests=max_queued_requests,
     )
     return serve.run(
         app, wait_ready=wait_ready, ready_timeout_s=ready_timeout_s

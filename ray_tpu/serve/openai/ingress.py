@@ -374,6 +374,7 @@ def build_openai_deployment(
     autoscaling_config: Optional[Dict[str, Any]] = None,
     ray_actor_options: Optional[Dict[str, float]] = None,
     prefill_deployment: Optional[str] = None,
+    max_queued_requests: Optional[int] = None,
 ):
     """Bind the multi-replica OpenAI front door (use serve.llm.deploy to
     also run it)."""
@@ -388,6 +389,7 @@ def build_openai_deployment(
         max_concurrency=max_concurrency,
         autoscaling_config=autoscaling_config,
         ray_actor_options=ray_actor_options,
+        max_queued_requests=max_queued_requests,
     )
     return dep.bind(
         models, tokenizer=tokenizer,

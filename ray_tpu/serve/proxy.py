@@ -54,13 +54,43 @@ _FAST_MAX_BODY = 64 * 1024
 @ray_tpu.remote
 class ServeProxy:
     def __init__(self, port: int = 0, controller_name: str = "SERVE_CONTROLLER"):
+        from ray_tpu.serve.autoscale.admission import AdmissionController
         from ray_tpu.serve.router import Router
 
         controller = ray_tpu.get_actor(controller_name)
         self._router = Router(controller)
+        self._admission = AdmissionController()
         self._server = AioHttpServer(
             self._handle, port=port, fast_handler=self._try_fast
         )
+
+    # -- admission control (serve/autoscale/admission.py) ----------------
+
+    def _admit(self, deployment: str, model_id: Optional[str]):
+        """One admission attempt: None = admitted (caller owns exactly
+        one release), or a Shed to return. The per-deployment bound comes
+        from the routing table (deploy-time max_queued_requests) with
+        RT_SERVE_ADMISSION_MAX_INFLIGHT as the default."""
+        cap = self._router.max_queued_requests(deployment)
+        return self._admission.try_acquire(
+            deployment, model_id=model_id, max_inflight=cap
+        )
+
+    @staticmethod
+    def _shed_response(shed, openai: bool):
+        """429/503 + Retry-After: the overload contract. OpenAI routes
+        get an OpenAI-shaped error body; everything else plain JSON."""
+        if openai:
+            body = oai.error_body(
+                shed.message, err_type=shed.err_type, code=shed.reason
+            )
+        else:
+            body = json.dumps({
+                "error": shed.message,
+                "reason": shed.reason,
+                "retry_after_s": shed.retry_after_s,
+            }).encode()
+        return shed.status, "application/json", body, shed.headers()
 
     # -- fast path (runs ON the event loop; must never block) ------------
 
@@ -107,6 +137,16 @@ class ServeProxy:
         if picked is None:
             return None
         deployment, rid, handle = picked
+        shed = self._admit(deployment, model_id)
+        if shed is not None:
+            # shed BEFORE the replica RPC: overload never reaches an
+            # engine, and the reply is a plain tuple (no pool hop)
+            self._router.request_finished(rid)
+            if trace is not None:
+                self._trace_end(
+                    (trace[0], deployment, trace[2]), shed.status
+                )
+            return self._shed_response(shed, openai=probe is not None)
         if trace is not None:
             # fill in the deployment the pick resolved; stamp the pick
             # itself as the (sub-ms) router leg of this trace
@@ -124,6 +164,7 @@ class ServeProxy:
         if client is None or client._sock is None:
             # cold address/connection: resolving would block the loop
             self._router.request_finished(rid)
+            self._admission.release(deployment, model_id)
             return None
         request = Request(method, path, body, headers, query)
         try:
@@ -133,9 +174,11 @@ class ServeProxy:
             )
         except RpcError:
             self._router.request_finished(rid)
+            self._admission.release(deployment, model_id)
             return None  # connection just dropped: pool path re-routes
         return self._await_direct(pending, rid, openai=probe is not None,
-                                  trace=trace)
+                                  trace=trace,
+                                  admitted=(deployment, model_id))
 
     def _trace_begin(self, headers, deployment):
         """Mint (or adopt) the trace id, inject it into the request
@@ -165,7 +208,7 @@ class ServeProxy:
             ))
 
     async def _await_direct(self, pending, rid: str, openai: bool,
-                            trace=None):
+                            trace=None, admitted=None):
         from ray_tpu.serve.router import Router
         from ray_tpu.utils.rpc import RemoteError
 
@@ -222,6 +265,8 @@ class ServeProxy:
             return 200, "application/json", json.dumps(result).encode()
         finally:
             self._router.request_finished(rid)
+            if admitted is not None:
+                self._admission.release(*admitted)
             if status is not None:
                 self._trace_end(trace, status)
 
@@ -235,18 +280,15 @@ class ServeProxy:
         if query.get("stream") in ("1", "true"):
             return self._handle_streaming(method, path, query, headers, body)
         try:
-            status, ctype, payload = self._dispatch(
-                method, path, query, headers, body
-            )
+            return self._dispatch(method, path, query, headers, body)
         except (TimeoutError, RpcTimeout) as e:
-            status, ctype, payload = 503, "application/json", json.dumps(
+            return 503, "application/json", json.dumps(
                 {"error": str(e)}
             ).encode()
         except Exception as e:  # noqa: BLE001 — app errors -> 500
-            status, ctype, payload = 500, "application/json", json.dumps(
+            return 500, "application/json", json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}
             ).encode()
-        return status, ctype, payload
 
     def _handle_streaming(self, method, path, query, headers, body):
         """?stream=1: a generator — the asyncio server turns each yielded
@@ -256,6 +298,15 @@ class ServeProxy:
             return 404, "application/json", json.dumps(
                 {"error": f"no route for {path}"}
             ).encode()
+        model_id: Optional[str] = (
+            headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
+        )
+        shed = self._admit(deployment, model_id)
+        if shed is not None:
+            # shed is a unary reply even on a would-be stream: the
+            # client gets headers + body + Retry-After, never a hung
+            # half-open chunked response
+            return self._shed_response(shed, openai=False)
         trace = self._trace_begin(headers, deployment)
         request = Request(method, path, body, headers, query)
 
@@ -274,6 +325,7 @@ class ServeProxy:
                     {"error": f"{type(e).__name__}: {e}"}
                 ).encode() + b"\n"
             finally:
+                self._admission.release(deployment, model_id)
                 self._trace_end(trace, 200)
 
         return gen()
@@ -292,9 +344,15 @@ class ServeProxy:
             )
         from ray_tpu.utils.config import config
 
+        shed = self._admit(deployment, probe.model)
+        if shed is not None:
+            # one unary 429/503 + Retry-After whether the request wanted
+            # SSE or not: overload must never open a stream
+            return self._shed_response(shed, openai=True)
         trace = self._trace_begin(headers, deployment)
         request = Request(method, path, body, headers, query)
         if probe.stream:
+            # the stream generator owns the admission slot from here
             return self._openai_stream(deployment, request, probe, trace)
         try:
             result = self._router.call_direct(
@@ -314,6 +372,8 @@ class ServeProxy:
             return 500, "application/json", oai.error_body(
                 f"{type(e).__name__}: {e}", err_type="internal_error"
             )
+        finally:
+            self._admission.release(deployment, probe.model)
         out = oai.split_http_result(result)
         self._trace_end(trace, out[0])
         return out
@@ -344,6 +404,10 @@ class ServeProxy:
             except Exception as e:  # noqa: BLE001 — mid-stream trailer
                 yield oai.sse_error(f"{type(e).__name__}: {e}")
             finally:
+                # admission slot acquired by _handle_openai: a stream
+                # occupies replica capacity until it closes, so it holds
+                # its slot just as long
+                self._admission.release(deployment, probe.model)
                 self._trace_end(trace, 200)
 
         return 200, oai.SSE_CONTENT_TYPE, gen()
@@ -369,11 +433,17 @@ class ServeProxy:
         model_id: Optional[str] = (
             headers.get(_MODEL_ID_HEADER) or query.get("model_id") or None
         )
+        shed = self._admit(deployment, model_id)
+        if shed is not None:
+            return self._shed_response(shed, openai=False)
         trace = self._trace_begin(headers, deployment)
         request = Request(method, path, body, headers, query)
-        result = self._router.call_direct(
-            deployment, request, timeout_s=120, model_id=model_id
-        )
+        try:
+            result = self._router.call_direct(
+                deployment, request, timeout_s=120, model_id=model_id
+            )
+        finally:
+            self._admission.release(deployment, model_id)
         if isinstance(result, (bytes, bytearray, memoryview)):
             self._trace_end(trace, 200)
             return 200, "application/json", result

@@ -123,6 +123,18 @@ class Router:
                         best = name
             return best
 
+    def max_queued_requests(self, deployment: str) -> Optional[int]:
+        """Per-deployment admission bound from the routing table
+        (@serve.deployment(max_queued_requests=...)); None means the
+        global RT_SERVE_ADMISSION_MAX_INFLIGHT applies. Table-shipped so
+        every proxy enforces the deploy-time bound without a config
+        round-trip."""
+        with self._lock:
+            dep = self._table.get(deployment)
+            if dep is None:
+                return None
+            return dep.get("max_queued_requests")
+
     @staticmethod
     def _rendezvous(session_key: str, replicas):
         """Highest-random-weight choice: stable per (session, replica

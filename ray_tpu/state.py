@@ -291,6 +291,28 @@ def timeline(address: Optional[str] = None,
                 },
             })
             continue
+        if etype == "autoscale":
+            # serve autoscaler decisions: global instants so a scale-up
+            # marker lines up against the TTFT spans that triggered it
+            trace.append({
+                "name": (
+                    f"autoscale:{e.get('deployment', '?')}:"
+                    f"{e.get('direction', '?')}"
+                ),
+                "cat": "autoscale",
+                "ph": "i",
+                "s": "g",
+                "ts": e["ts_us"],
+                "pid": e.get("worker") or e.get("pid", 0),
+                "tid": e.get("pid", 0),
+                "args": {
+                    k: e[k]
+                    for k in ("deployment", "from", "to", "direction",
+                              "reason")
+                    if e.get(k) is not None
+                },
+            })
+            continue
         if etype == "stall":
             # stall watchdog marker: a process-scoped instant carrying
             # the stuck thread's stack, joinable by task_id
@@ -717,6 +739,30 @@ def alerts(address: Optional[str] = None) -> Dict[str, Any]:
     return _with_control(
         address, lambda c: c.call("alerts", timeout_s=10.0)
     )
+
+
+def autoscale_status(address: Optional[str] = None) -> Dict[str, Any]:
+    """Serve control-loop snapshot the controller publishes to the head
+    KV each reconcile tick (serve/controller.py _publish_status): per
+    deployment the replica targets, running/draining counts with
+    per-drainer progress, the last autoscale decision and the signals
+    behind it. Returns {} when no controller is publishing (or the
+    snapshot is stale — controller gone > 60s)."""
+    try:
+        raw = _control(address).call(
+            "kv_get", ns="serve", key="autoscale_status", timeout_s=5.0
+        )
+    except Exception:  # noqa: BLE001 — no head / no serve: empty
+        return {}
+    if not raw:
+        return {}
+    try:
+        rec = json.loads(bytes(raw).decode())
+    except (ValueError, UnicodeDecodeError):
+        return {}
+    if time.time() - rec.get("ts", 0) > 60.0:  # controller gone: stale
+        return {}
+    return rec.get("deployments", {})
 
 
 def _fleet_addresses(

@@ -337,6 +337,43 @@ config.define("blackbox_interval_s", 5.0)
 # Firing page-severity alerts attach one all-thread stack capture to
 # the alert event, at most once per this interval.
 config.define("alert_capture_min_interval_s", 60.0)
+# Serving control loop (ISSUE 17, serve/autoscale/). The policy engine
+# replaces the naive requests-per-replica autoscaler: every
+# serve_autoscale_interval_s the controller reads windowed TTFT p95 /
+# KV occupancy / queue depth from the head's metrics history (over
+# serve_autoscale_window_s) plus the burn-rate alert state, and scales
+# with hysteresis — up at the high watermarks (or a firing TTFT burn
+# alert), down one replica at a time only after every signal stayed
+# below the low watermarks for serve_autoscale_down_cooldown_s.
+config.define("serve_autoscale_interval_s", 2.0)
+config.define("serve_autoscale_window_s", 30.0)
+config.define("serve_autoscale_up_cooldown_s", 2.0)
+config.define("serve_autoscale_down_cooldown_s", 15.0)
+# TTFT pressure watermark as a fraction of the SLO target
+# (alerts_ttft_target_s): p95 above target*high_frac is a scale-up
+# hint; below target*low_frac counts toward sustained-ok.
+config.define("serve_autoscale_ttft_high_frac", 0.8)
+config.define("serve_autoscale_ttft_low_frac", 0.4)
+# KV-slot occupancy (occupied/total) watermarks.
+config.define("serve_autoscale_kv_high_frac", 0.85)
+config.define("serve_autoscale_kv_low_frac", 0.5)
+# Session-aware drain: a scale-down victim stops taking new sessions
+# (dropped from the routing table, HRW re-pins its sessions) and exits
+# when its in-flight streams finish — or at this deadline, force-killed.
+config.define("serve_autoscale_drain_deadline_s", 30.0)
+# Admission control + load shedding at the proxy: bounded per-deployment
+# in-flight work (queued + executing at THIS proxy; 503 + Retry-After
+# past the bound — per-deployment override via
+# @serve.deployment(max_queued_requests=...)) and an optional per-model
+# concurrency cap (429 + Retry-After; 0 = uncapped). Kill switch:
+# RT_SERVE_ADMISSION_ENABLED=0 admits everything.
+config.define("serve_admission_enabled", True)
+config.define("serve_admission_max_inflight", 256)
+config.define("serve_admission_model_concurrency", 0)
+config.define("serve_admission_retry_after_s", 1.0)
+# Shed-rate alert rule: sustained sheds/s (rt_serve_shed_total windowed
+# rate) above this trips serve_shed_rate.
+config.define("alerts_shed_rate_max", 1.0)
 
 # --- Per-host / per-process flags (dynamic) ----------------------------
 # Re-read from the environment on every access and EXCLUDED from

@@ -13,7 +13,12 @@ Checked contexts:
    wherever they are called, e.g. shipped to the pool);
 2. the serve fast-handler path: any function passed as a
    ``fast_handler=`` keyword argument in the same file (``self._x`` /
-   bare-name references are resolved to same-file defs).
+   bare-name references are resolved to same-file defs);
+3. functions listed in ``ON_LOOP_FUNCTIONS`` — on-loop helpers called
+   FROM a fast handler in another file, which the same-file
+   ``fast_handler=`` resolution cannot see (the proxy admission
+   controller: ``try_acquire``/``release`` run on the proxy's event
+   loop for every request).
 
 Flagged calls:
 
@@ -35,6 +40,7 @@ different names anyway).  Suppress with
 from __future__ import annotations
 
 import ast
+import os
 from typing import List, Optional, Set, Tuple
 
 from tools.rtlint.engine import FileContext, LintPass
@@ -44,6 +50,14 @@ BLOCKING_SOCKET_METHODS = {
 }
 SYNC_WAIT_METHODS = {"result", "join", "acquire", "wait"}
 SYNC_RPC_METHODS = {"call"}
+
+# file-suffix -> function names that run on a proxy event loop despite
+# being plain sync defs in another module (cross-file fast-path helpers)
+ON_LOOP_FUNCTIONS = {
+    os.path.join("ray_tpu", "serve", "autoscale", "admission.py"): (
+        "try_acquire", "release", "inflight",
+    ),
+}
 
 
 def _fast_handler_names(tree: ast.Module) -> Set[str]:
@@ -165,6 +179,9 @@ class BlockingAsyncPass(LintPass):
 
     def run(self, ctx: FileContext) -> List[Tuple[int, str]]:
         fast_names = _fast_handler_names(ctx.tree)
+        for sfx, names in ON_LOOP_FUNCTIONS.items():
+            if ctx.relpath.endswith(sfx):
+                fast_names |= set(names)
         time_sleep = {
             n for n in _imported_names(ctx.tree, "time") if n == "sleep"
         }

@@ -47,6 +47,16 @@ from tools.rtlint.engine import FileContext, LintPass
 
 CHECKED_BASENAMES = {"control_store.py", "node_agent.py"}
 HANDLER_PREFIXES = ("rpc_", "_raw_")
+# actor-method dispatchers under the same discipline: these methods run
+# on a worker's bounded executor and take caller-supplied deadlines, so
+# an unsliced wait strands an executor thread exactly like an rpc_*
+# handler strands a dispatcher thread (serve clients re-issue slices —
+# see serve/api.py _wait_ready)
+EXTRA_HANDLERS = {
+    os.path.join("ray_tpu", "serve", "controller.py"): (
+        "get_routing_table", "ready",
+    ),
+}
 # a min(..., c) bound at or below this many seconds counts as sliced
 SLICE_MAX_S = 5.0
 WAIT_METHODS = {"wait"}
@@ -251,7 +261,9 @@ class DispatcherBlockPass(LintPass):
            "caller-supplied deadline")
 
     def select(self, relpath: str) -> bool:
-        return os.path.basename(relpath) in CHECKED_BASENAMES
+        if os.path.basename(relpath) in CHECKED_BASENAMES:
+            return True
+        return any(relpath.endswith(sfx) for sfx in EXTRA_HANDLERS)
 
     def run(self, ctx: FileContext) -> List[Tuple[int, str]]:
         consts = ctx.module_constants
@@ -259,9 +271,15 @@ class DispatcherBlockPass(LintPass):
         for name, fn in ctx.functions:
             by_name.setdefault(name, fn)
 
+        extra: Tuple[str, ...] = ()
+        for sfx, names in EXTRA_HANDLERS.items():
+            if ctx.relpath.endswith(sfx):
+                extra = names
+                break
+
         out: List[Tuple[int, str]] = []
         for name, fn in ctx.functions:
-            if not name.startswith(HANDLER_PREFIXES):
+            if not (name.startswith(HANDLER_PREFIXES) or name in extra):
                 continue
             params = _handler_params(fn)
             uncapped = params - _capped_params(fn, params)

@@ -41,6 +41,11 @@ HOT_PATHS = (
     os.path.join("ray_tpu", "serve", "proxy.py"),
     os.path.join("ray_tpu", "serve", "replica.py"),
     os.path.join("ray_tpu", "serve", "router.py"),
+    # serve control loop: the controller's reconcile tick issues RPC
+    # sends (status publish, drain kills); payloads must stay tiny
+    # control records — the ~1 KiB autoscale_status JSON is opted out
+    # per line, anything bulkier must ride a Frame
+    os.path.join("ray_tpu", "serve", "controller.py"),
     # collective transport: ring chunk deliveries must pass ndarrays /
     # Frame-wrapped values so they ride as out-of-band segments; only
     # the KV fallback (which stores contiguous blobs by design) and the
