@@ -41,12 +41,23 @@ Legs (``--leg``):
   surplus must shed CLEANLY — instant unary 429/503 + Retry-After,
   counted client-side (``shed_503``/``shed_429``) and server-side
   (``rt_serve_shed_total`` delta), with zero client hangs.
+- ``pagedkv``: interleaved same-day A/B of the paged KV engine against
+  the pre-paged slot engine (``RT_SERVE_PAGED_KV=0`` semantics, flipped
+  per-arm via ``LLMConfig(paged_kv=...)`` so no env churn) at MATCHED
+  memory — the paged pool auto-sizes to exactly the slot cache's element
+  count. Arms run paged/slot/paged/slot, each a fresh redeploy + its own
+  identically-seeded Poisson window, so drift affects both engines
+  equally. Each arm records client goodput + tokens/s plus the
+  server-side ``rt_serve_batch_fill`` histogram delta (mean fill — the
+  page-based-admission shift) and the ``rt_serve_kv_block_copies_total``
+  delta (paged prefix hits must not copy).
 
 Every run appends one row to BENCH_SERVE.json.
 
 Run: python bench_serve.py --rate 30 --duration 20
      python bench_serve.py --leg swing --rate 2 --duration 60
      python bench_serve.py --leg overload --rate 3 --duration 15
+     python bench_serve.py --leg pagedkv --rate 30 --duration 15
 """
 
 import argparse
@@ -153,6 +164,204 @@ def _sum_ttft_hist(mx):
     return bounds, (buckets or []), count
 
 
+def _batch_fill_totals(mx):
+    """(count, sum) of rt_serve_batch_fill summed across series."""
+    m = mx.get("rt_serve_batch_fill") or {}
+    cnt = sm = 0.0
+    for h in (m.get("series") or {}).values():
+        cnt += float(h.get("count", 0.0))
+        sm += float(h.get("sum", 0.0))
+    return cnt, sm
+
+
+def _counter_total(mx, name):
+    m = mx.get(name) or {}
+    return float(sum((m.get("series") or {}).values()))
+
+
+def _append_row(path, row):
+    doc = {"schema": 1, "rows": []}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError:
+            pass
+    doc.setdefault("rows", []).append(row)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+def _run_arm_window(host, port, args):
+    """One open-loop Poisson window with a per-arm re-seeded RNG, so
+    every A/B arm replays the identical arrival schedule + prompt mix."""
+    rng = random.Random(args.seed)
+    arrivals = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(args.rate)
+        if t >= args.duration:
+            break
+        arrivals.append(t)
+    results = []
+    lock = threading.Lock()
+    inflight = threading.Semaphore(args.max_inflight)
+    shed = 0
+    threads = []
+
+    def worker(prompt_len):
+        try:
+            rec = _stream_one(
+                host, port, prompt_len, args.max_tokens, args.timeout
+            )
+        finally:
+            inflight.release()
+        with lock:
+            results.append(rec)
+
+    t0 = time.perf_counter()
+    for at in arrivals:
+        delay = at - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        if not inflight.acquire(blocking=False):
+            shed += 1
+            continue
+        th = threading.Thread(
+            target=worker,
+            args=(_sample_prompt_len(
+                rng, args.prompt_median, args.prompt_sigma, args.prompt_cap,
+            ),),
+            daemon=True,
+        )
+        th.start()
+        threads.append(th)
+    hung = 0
+    for th in threads:
+        th.join(timeout=args.timeout + 30)
+        hung += th.is_alive()
+    wall_s = time.perf_counter() - t0
+    return results, len(arrivals), shed, hung, wall_s
+
+
+def _pagedkv_leg(args, host_meta):
+    """Interleaved paged-vs-slot A/B. Redeploying the same deployment
+    name swaps the engine (the controller replaces replicas in place and
+    the /v1 route survives), and metric deltas are taken strictly inside
+    each arm's replica lifetime, so histogram sums never go backwards
+    under the merge."""
+    import ray_tpu
+    from ray_tpu import serve, state
+    from ray_tpu.serve import llm as serve_llm
+
+    order = [("paged", True), ("slot", False), ("paged", True),
+             ("slot", False)]
+    ray_tpu.init(num_cpus=max(8, args.replicas * 2))
+    serve.start(http_port=0)
+    arms = []
+    try:
+        for i, (label, paged) in enumerate(order):
+            serve_llm.deploy(
+                {MODEL: serve_llm.LLMConfig(
+                    model_id="gpt2-tiny",
+                    max_batch_size=args.max_batch_size,
+                    paged_kv=paged,
+                )},
+                name=DEPLOYMENT, route_prefix="/v1",
+                num_replicas=args.replicas,
+            )
+            deadline = time.monotonic() + 60
+            addrs = []
+            while time.monotonic() < deadline and not addrs:
+                addrs = serve.proxy_addresses()
+                time.sleep(0.2)
+            assert addrs, "no HTTP proxy came up"
+            host, port = addrs[0].rsplit(":", 1)
+            port = int(port)
+            for n in (8, args.prompt_median, args.prompt_median * 4):
+                for _ in range(args.replicas):
+                    _stream_one(host, port, n, 4, args.timeout)
+
+            mx0 = state.cluster_metrics()
+            c0, s0 = _batch_fill_totals(mx0)
+            cp0 = _counter_total(mx0, "rt_serve_kv_block_copies_total")
+            results, scheduled, shed, hung, wall_s = _run_arm_window(
+                host, port, args
+            )
+            mx1 = state.cluster_metrics()
+            c1, s1 = _batch_fill_totals(mx1)
+            cp1 = _counter_total(mx1, "rt_serve_kv_block_copies_total")
+
+            ok = [r for r in results if r.get("ok")]
+            ttfts = sorted(r["ttft"] for r in ok)
+            itls = sorted(g for r in ok for g in r["itls"])
+            tokens = sum(r["tokens"] for r in ok)
+            fill = (s1 - s0) / (c1 - c0) if c1 > c0 else None
+            p95 = _percentile(ttfts, 0.95)
+            itl95 = _percentile(itls, 0.95)
+            arms.append({
+                "arm": i,
+                "engine": label,
+                "scheduled": scheduled,
+                "requests_ok": len(ok),
+                "errors": len(results) - len(ok),
+                "shed_client": shed,
+                "hung_clients": hung,
+                "goodput_rps": round(len(ok) / wall_s, 2),
+                "tokens_per_s": round(tokens / wall_s, 1),
+                "batch_fill_mean": (
+                    round(fill, 3) if fill is not None else None
+                ),
+                "ttft_p95_ms": round(p95 * 1e3, 1) if p95 else None,
+                "itl_p95_ms": round(itl95 * 1e3, 2) if itl95 else None,
+                "kv_block_copies": max(0.0, round(cp1 - cp0, 0)),
+            })
+            print(json.dumps({"arm_done": arms[-1]}), flush=True)
+
+        def mean_of(engine, key):
+            vals = [
+                a[key] for a in arms
+                if a["engine"] == engine and a[key] is not None
+            ]
+            return sum(vals) / len(vals) if vals else None
+
+        summary = {}
+        for key in ("goodput_rps", "tokens_per_s", "batch_fill_mean"):
+            p, s = mean_of("paged", key), mean_of("slot", key)
+            summary[key] = {
+                "paged": round(p, 3) if p is not None else None,
+                "slot": round(s, 3) if s is not None else None,
+                "ratio": round(p / s, 3) if p and s else None,
+            }
+        summary["kv_block_copies"] = {
+            "paged": mean_of("paged", "kv_block_copies"),
+            "slot": None,  # slot engine doesn't publish the counter
+        }
+        row = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "host": host_meta,
+            "leg": "pagedkv",
+            "rate_rps": args.rate,
+            "duration_s": args.duration,
+            "replicas": args.replicas,
+            "max_batch_size": args.max_batch_size,
+            "max_tokens": args.max_tokens,
+            "prompt": {"median": args.prompt_median,
+                       "sigma": args.prompt_sigma, "cap": args.prompt_cap},
+            "arms": arms,
+            "summary": summary,
+        }
+        print(json.dumps(row, indent=2))
+        _append_row(args.out, row)
+        assert all(a["requests_ok"] for a in arms), "an arm served nothing"
+        print(json.dumps({"ok": True, "summary": summary}))
+        return 0
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
 def _autoscale_sampler(stop, out, deployment):
     """1 Hz recorder of the serve control loop: replica trajectory +
     every distinct autoscale decision (deduped by decision timestamp)."""
@@ -180,11 +389,13 @@ def _autoscale_sampler(stop, out, deployment):
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--leg", choices=("steady", "swing", "overload"),
+    ap.add_argument("--leg",
+                    choices=("steady", "swing", "overload", "pagedkv"),
                     default="steady",
                     help="load shape: one rate, a 10x swing against an "
-                         "autoscaling deployment, or sustained overload "
-                         "against a tight admission bound")
+                         "autoscaling deployment, sustained overload "
+                         "against a tight admission bound, or an "
+                         "interleaved paged-vs-slot KV engine A/B")
     ap.add_argument("--rate", type=float, default=30.0,
                     help="mean arrival rate, requests/s (Poisson); the "
                          "swing/overload legs burst at 10x this")
@@ -238,6 +449,9 @@ def main() -> int:
     }
     if swept.get("killed") or swept.get("removed"):
         print(json.dumps({"swept_stale_runtime": swept}), flush=True)
+
+    if args.leg == "pagedkv":
+        return _pagedkv_leg(args, host_meta)
 
     rng = random.Random(args.seed)
     ray_tpu.init(num_cpus=max(8, args.replicas * 2))
@@ -480,17 +694,7 @@ def main() -> int:
             }
         print(json.dumps(row, indent=2))
 
-        doc = {"schema": 1, "rows": []}
-        if os.path.exists(args.out):
-            try:
-                with open(args.out) as f:
-                    doc = json.load(f)
-            except ValueError:
-                pass
-        doc.setdefault("rows", []).append(row)
-        with open(args.out, "w") as f:
-            json.dump(doc, f, indent=2)
-            f.write("\n")
+        _append_row(args.out, row)
 
         if not agree:
             print(

@@ -207,6 +207,17 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
         row(dep)["tokens"] = int(v)
     for dep, v in by_tag("rt_serve_kv_slots_occupied", "deployment").items():
         row(dep)["kv_slots"] = f"{v:g}"
+    # paged engines: occupied/total pages + sealed prefix residents
+    pg_occ = by_tag("rt_serve_kv_pages_occupied", "deployment")
+    pg_tot = by_tag("rt_serve_kv_pages_total", "deployment")
+    pg_res = by_tag("rt_serve_kv_pages_prefix_resident", "deployment")
+    for dep in set(pg_occ) | set(pg_tot):
+        cell = f"{pg_occ.get(dep, 0.0):g}"
+        if pg_tot.get(dep):
+            cell += f"/{pg_tot[dep]:g}"
+        if dep in pg_res:
+            cell += f" ({pg_res[dep]:g}pfx)"
+        row(dep)["kv_pages"] = cell
     for dep, v in by_tag("rt_serve_queued_requests", "deployment").items():
         row(dep)["queued"] = f"{v:g}"
     for dep, h in hist_by_tag("rt_serve_ttft_s", "deployment").items():
@@ -225,7 +236,12 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
     for dep in set(hits) | set(misses):
         total = hits.get(dep, 0.0) + misses.get(dep, 0.0)
         if total:
-            row(dep)["cache_hit"] = f"{100.0 * hits.get(dep, 0.0) / total:.0f}%"
+            pct = f"{100.0 * hits.get(dep, 0.0) / total:.0f}%"
+            row(dep)["cache_hit"] = pct
+            # paged engines match PAGES, not host blocks: surface the
+            # same ratio under the page-hit name next to kv_pages
+            if dep in pg_occ or dep in pg_tot:
+                row(dep)["page_hit"] = pct
     for dep, v in by_tag("rt_serve_shed_total", "deployment").items():
         if v:
             row(dep)["shed"] = int(v)
@@ -249,8 +265,9 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
             f"{qps.get(dep, 0.0):.1f}" if qps is not None else "-"
         )
     columns = ["deployment", "replicas", "reqs", "qps", "ttft_p50_ms",
-               "ttft_p95_ms", "itl_p50_ms", "tokens", "kv_slots", "queued",
-               "shed", "batch_fill", "cache_hit", "last_scale"]
+               "ttft_p95_ms", "itl_p50_ms", "tokens", "kv_slots",
+               "kv_pages", "queued", "shed", "batch_fill", "cache_hit",
+               "page_hit", "last_scale"]
     if hist is not None:
         # windowed view from the history store: TTFT p95 over the last
         # --since seconds (not since boot) + a QPS sparkline

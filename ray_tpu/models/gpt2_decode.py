@@ -294,6 +294,216 @@ def decode_and_sample(cfg: GPT2Config, params, last_tokens, lengths,
     return nxt, lengths + 1, cache_k, cache_v
 
 
+# -- paged KV cache (one pool for generation + prefix pages) -----------
+#
+# vLLM-style paged attention at the jnp level: physical KV pages
+# ``[L, N_pages, B, H, Dh]`` in HBM, per-sequence page tables
+# ``[S, MaxPages]`` mapping virtual position p to physical row
+# (table[p // B], p % B). A prefix-cache hit points the table at pages
+# another sequence already wrote (zero copies); admission reserves
+# ceil(tokens/B) pages up front so tables never change mid-flight.
+# Page 0 is reserved scratch: inactive rows carry all-zero tables and
+# length 0, so their junk scatters land there and the jitted step needs
+# no validity branch (same masked-static-batch regime as the slot
+# kernels above).
+
+
+def init_paged_cache(cfg: GPT2Config, num_pages: int, page_tokens: int):
+    """(k, v) page pools: [n_layer, N_pages, B, H, Dh], compute dtype."""
+    shape = (cfg.n_layer, num_pages, page_tokens, cfg.n_head, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+@partial(jax.jit, donate_argnums=(2, 3))
+def write_pages(k_blocks, v_blocks, cache_k, cache_v, pages):
+    """Batched page import (disaggregated KV shipment): write
+    ``k_blocks``/``v_blocks`` [L, n, B, H, Dh] into physical pages
+    ``pages`` [n] of the pool. The ONLY block-copy path left in the
+    paged engine — prefix hits bump refcounts instead."""
+    ck = cache_k.at[:, pages].set(k_blocks.astype(cache_k.dtype))
+    cv = cache_v.at[:, pages].set(v_blocks.astype(cache_v.dtype))
+    return ck, cv
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
+def prefill_paged(cfg: GPT2Config, params, tokens, start, length, cache_k,
+                  cache_v, page_table):
+    """Prefill one CHUNK of a prompt into paged KV: ``tokens`` [1, P]
+    (right-padded, ``length`` real) are virtual positions
+    start..start+P-1 of the sequence whose page table is ``page_table``
+    [MaxPages]; pages holding positions 0..start-1 are already written
+    (a prefix hit, a KV import, or this sequence's previous chunk —
+    chunked prefill is just repeated calls with advancing ``start``).
+    Scatters the chunk's K/V through the page table, attends the chunk
+    over the whole gathered row, and returns the last real position's
+    logits [vocab] plus the updated pools.
+
+    The caller guarantees start + P <= MaxPages * B (bucket the chunk
+    width against that cap); positions past the sequence's reserved
+    pages hit table entries that are 0 = the scratch page, so padding
+    scatters are harmless exactly like prefill_extend's padded tail."""
+    dt = cfg.dtype
+    P = tokens.shape[1]
+    B = cache_k.shape[2]
+    max_pages = page_table.shape[0]
+    T = max_pages * B  # virtual row width
+    W = params["wpe"].shape[0]
+    pos = start + jnp.arange(P)
+    x = (
+        params["wte"].astype(dt)[tokens]
+        + params["wpe"].astype(dt)[jnp.clip(pos, 0, W - 1)][None]
+    )
+    # chunk position start+i may attend every written position 0..start+i
+    mask = jnp.arange(T)[None] <= pos[:, None]  # [P, T]
+    page_of = page_table[jnp.clip(pos // B, 0, max_pages - 1)]  # [P]
+    off = pos % B
+
+    def body(layer_idx, carry):
+        x, ck, cv = carry
+        layer = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, layer_idx, axis=0, keepdims=False
+            ),
+            params["blocks"],
+        )
+        h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        q, k, v = _qkv(h, layer, cfg)  # [1, P, H, Dh]
+        # scatter the chunk's K/V through the page table (in place on
+        # the donated carry), then gather the whole virtual row so the
+        # chunk sees prefix pages it never computed
+        ck = ck.at[layer_idx, page_of, off].set(k[0].astype(dt))
+        cv = cv.at[layer_idx, page_of, off].set(v[0].astype(dt))
+        ck_l = jax.lax.dynamic_index_in_dim(
+            ck, layer_idx, axis=0, keepdims=False
+        )[page_table].reshape(T, cfg.n_head, cfg.head_dim)[None]
+        cv_l = jax.lax.dynamic_index_in_dim(
+            cv, layer_idx, axis=0, keepdims=False
+        )[page_table].reshape(T, cfg.n_head, cfg.head_dim)[None]
+        scale = 1.0 / (cfg.head_dim ** 0.5)
+        scores = jnp.einsum("bthn,bshn->bhts", q, ck_l) * scale
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        att = jnp.einsum("bhts,bshn->bthn", probs, cv_l)
+        x = _proj_mlp(x, att, layer, cfg)
+        return x, ck, cv
+
+    x, cache_k, cache_v = jax.lax.fori_loop(
+        0, cfg.n_layer, body, (x, cache_k, cache_v)
+    )
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    last = jax.lax.dynamic_index_in_dim(
+        x[0], jnp.maximum(length - 1, 0), axis=0, keepdims=False
+    )
+    logits = jnp.einsum(
+        "d,vd->v", last.astype(dt), params["wte"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits[: cfg.vocab_size], cache_k, cache_v
+
+
+def _decode_paged_impl(cfg: GPT2Config, params, last_tokens, lengths,
+                       cache_k, cache_v, page_tables):
+    """One token for every sequence over paged KV: [S] last tokens at
+    virtual positions ``lengths`` scatter their new K/V through
+    ``page_tables`` [S, MaxPages] and attend over their gathered rows.
+    Returns logits [S, vocab] and the updated pools."""
+    dt = cfg.dtype
+    S = last_tokens.shape[0]
+    B = cache_k.shape[2]
+    max_pages = page_tables.shape[1]
+    T = max_pages * B
+    W = params["wpe"].shape[0]
+    pos = jnp.clip(lengths, 0, T - 1)
+    x = (
+        params["wte"].astype(dt)[last_tokens][:, None]
+        + params["wpe"].astype(dt)[jnp.clip(pos, 0, W - 1)][:, None]
+    )  # [S, 1, D]
+    rows = jnp.arange(S)
+    mask = jnp.arange(T)[None] <= pos[:, None]  # attend 0..pos
+    page_of = page_tables[rows, pos // B]  # [S]
+    off = pos % B
+
+    def body(layer_idx, carry):
+        x, ck, cv = carry
+        layer = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(
+                a, layer_idx, axis=0, keepdims=False
+            ),
+            params["blocks"],
+        )
+        h = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
+        q, k, v = _qkv(h, layer, cfg)  # [S, 1, H, Dh]
+        # in-place scatter of the new token's K/V through the tables
+        # (inactive rows have zero tables: their junk lands in the
+        # scratch page)
+        ck = ck.at[layer_idx, page_of, off].set(k[:, 0].astype(dt))
+        cv = cv.at[layer_idx, page_of, off].set(v[:, 0].astype(dt))
+        ck_l = jax.lax.dynamic_index_in_dim(
+            ck, layer_idx, axis=0, keepdims=False
+        )[page_tables].reshape(S, T, cfg.n_head, cfg.head_dim)
+        cv_l = jax.lax.dynamic_index_in_dim(
+            cv, layer_idx, axis=0, keepdims=False
+        )[page_tables].reshape(S, T, cfg.n_head, cfg.head_dim)
+        scale = 1.0 / (cfg.head_dim ** 0.5)
+        scores = jnp.einsum("shn,sthn->sht", q[:, 0], ck_l) * scale
+        scores = jnp.where(mask[:, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dt)
+        att = jnp.einsum("sht,sthn->shn", probs, cv_l)[:, None]
+        x = _proj_mlp(x, att, layer, cfg)
+        return x, ck, cv
+
+    x, cache_k, cache_v = jax.lax.fori_loop(
+        0, cfg.n_layer, body, (x, cache_k, cache_v)
+    )
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = jnp.einsum(
+        "sd,vd->sv", x[:, 0].astype(dt), params["wte"].astype(dt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits[:, : cfg.vocab_size], cache_k, cache_v
+
+
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(4, 5))
+def decode_paged_and_sample(cfg: GPT2Config, params, last_tokens, lengths,
+                            cache_k, cache_v, page_tables, temps,
+                            greedy_mask, rng_base, step):
+    """Paged twin of :func:`decode_and_sample`: decode + sample (+ RNG
+    fold + cursor bump) fused into ONE dispatch."""
+    logits, cache_k, cache_v = _decode_paged_impl(
+        cfg, params, last_tokens, lengths, cache_k, cache_v, page_tables
+    )
+    rng = jax.random.fold_in(rng_base, step)
+    nxt = sample(logits, temps, greedy_mask, rng)
+    return nxt, lengths + 1, cache_k, cache_v
+
+
+@partial(jax.jit, static_argnums=(0, 10), donate_argnums=(4, 5))
+def decode_multi_paged(cfg: GPT2Config, params, last_tokens, lengths,
+                       cache_k, cache_v, page_tables, temps, greedy_mask,
+                       rng_base, n_steps: int, step0):
+    """Paged twin of :func:`decode_multi`: ``n_steps`` tokens per
+    sequence in ONE dispatch, page-table scatter recomputed per step
+    on device (the tables themselves are fixed — admission reserved
+    every page up front)."""
+    S = last_tokens.shape[0]
+    toks0 = jnp.zeros((n_steps, S), jnp.int32)
+
+    def body(i, carry):
+        last, lens, ck, cv, toks = carry
+        logits, ck, cv = _decode_paged_impl(
+            cfg, params, last, lens, ck, cv, page_tables
+        )
+        rng = jax.random.fold_in(rng_base, step0 + i)
+        nxt = sample(logits, temps, greedy_mask, rng)
+        toks = jax.lax.dynamic_update_index_in_dim(toks, nxt, i, axis=0)
+        return nxt, lens + 1, ck, cv, toks
+
+    last, lens, cache_k, cache_v, toks = jax.lax.fori_loop(
+        0, n_steps, body, (last_tokens, lengths, cache_k, cache_v, toks0)
+    )
+    return toks, last, lens, cache_k, cache_v
+
+
 @partial(jax.jit, static_argnums=(0, 9), donate_argnums=(4, 5))
 def decode_multi(cfg: GPT2Config, params, last_tokens, lengths, cache_k,
                  cache_v, temps, greedy_mask, rng_base, n_steps: int,

@@ -176,6 +176,35 @@ def _build() -> dict:
             "prefix KV blocks currently resident in this engine's pool",
             tag_keys=("deployment", "node"),
         ),
+        # paged KV pool (serve/prefix_cache.PagedKVPool): one page pool
+        # holds generation AND prefix KV; occupied counts pages pinned
+        # by live requests or resident as sealed prefix blocks. The
+        # paged engine ALSO publishes these numbers under the legacy
+        # rt_serve_kv_slots_{occupied,total} names (alias for one
+        # release) so the serve_kv_occupancy alert rule and older
+        # dashboards keep evaluating.
+        "serve_kv_pages_total": Gauge(
+            "rt_serve_kv_pages_total",
+            "KV page-pool capacity (pages) per engine process",
+            tag_keys=("deployment", "node"),
+        ),
+        "serve_kv_pages_occupied": Gauge(
+            "rt_serve_kv_pages_occupied",
+            "KV pages pinned by live requests or resident as sealed "
+            "prefix blocks, per engine process",
+            tag_keys=("deployment", "node"),
+        ),
+        "serve_kv_pages_prefix_resident": Gauge(
+            "rt_serve_kv_pages_prefix_resident",
+            "sealed prefix pages resident in this engine's page pool",
+            tag_keys=("deployment", "node"),
+        ),
+        "serve_kv_block_copies": Counter(
+            "rt_serve_kv_block_copies_total",
+            "KV block copies performed at admission (prefix-pool copy "
+            "or KV import write); a paged prefix hit performs ZERO",
+            tag_keys=("deployment",),
+        ),
         "serve_kv_transfer_bytes": Counter(
             "rt_serve_kv_transfer_bytes_total",
             "KV-cache bytes shipped prefill -> decode over rpc channels",

@@ -49,17 +49,25 @@ def channel_capacity(model_cfg) -> int:
 
 
 class PrefillEngine:
-    """Prefill-only engine: one KV row, no decode loop. Shares the
-    LLMServer's weights recipe (same PRNGKey(0) init / checkpoint), so
-    at temperature=0 the first token and KV rows are exactly what the
-    monolithic engine would have produced. Keeps its own prefix block
-    pool: shared-prefix traffic skips prefill flops here too."""
+    """Prefill-only engine: one working sequence, no decode loop.
+    Shares the LLMServer's weights recipe (same PRNGKey(0) init /
+    checkpoint), so at temperature=0 the first token and KV rows are
+    exactly what the monolithic engine would have produced.
+
+    With the paged pool on (RT_SERVE_PAGED_KV, the engine default) the
+    prefill tier runs on the SAME PagedKVPool + paged kernels as the
+    decode engine — prefix KV and working KV live in one device pool
+    and the shipment is a gather of the sequence's pages, eliminating
+    the third KV representation disagg used to maintain (slot row +
+    host BlockPool + wire tensors). The slot/BlockPool path survives
+    behind the kill switch."""
 
     def __init__(self, cfg) -> None:
         import jax
 
         from ray_tpu.models import gpt2
         from ray_tpu.serve import prefix_cache
+        from ray_tpu.utils.config import config
 
         self.cfg = cfg
         self.model_cfg = gpt2.CONFIGS[cfg.model_id]
@@ -71,9 +79,29 @@ class PrefillEngine:
         else:
             self.params = gpt2.init(jax.random.PRNGKey(0), self.model_cfg)
         self._rng = jax.random.PRNGKey(1)
-        self._pool = prefix_cache.BlockPool(cfg.model_id)
+        self._paged = (
+            bool(cfg.paged_kv)
+            if getattr(cfg, "paged_kv", None) is not None
+            else bool(config.serve_paged_kv)
+        )
+        if self._paged:
+            B = int(config.serve_prefix_block_tokens)
+            max_pages = -(-self.model_cfg.n_positions // B)
+            # resident-prefix capacity matching BlockPool's budget, plus
+            # one full working reservation (+ the scratch page 0), so
+            # alloc can always cover a prompt by evicting LRU residents
+            self._pool = prefix_cache.PagedKVPool(
+                cfg.model_id,
+                num_pages=(
+                    int(config.serve_prefix_pool_blocks) + max_pages + 1
+                ),
+                page_tokens=B,
+            )
+        else:
+            self._pool = prefix_cache.BlockPool(cfg.model_id)
         self._lock = threading.Lock()
-        self._cache_k = self._cache_v = None  # [L, 1, T, H, Dh], lazy
+        # slot path: [L, 1, T, H, Dh]; paged path: [L, N, B, H, Dh]
+        self._cache_k = self._cache_v = None  # lazy
 
     def prefill(self, prompt_tokens: List[int],
                 temperature: float) -> Dict[str, Any]:
@@ -96,6 +124,9 @@ class PrefillEngine:
             while p < n:
                 p *= 2
             return min(p, cap)
+
+        if self._paged:
+            return self._prefill_paged(prompt, temperature, bucket)
 
         with self._lock:
             if self._cache_k is None:
@@ -161,6 +192,97 @@ class PrefillEngine:
                 if pool is not None and held:
                     pool.release(held)
         n = len(prompt)
+        return {
+            "k": np.ascontiguousarray(row_k[:, :n]),
+            "v": np.ascontiguousarray(row_v[:, :n]),
+            "first_token": first,
+            "prompt_len": n,
+            "cached_tokens": cached,
+        }
+
+    def _prefill_paged(self, prompt: List[int], temperature: float,
+                       bucket) -> Dict[str, Any]:
+        """Paged-pool prefill: match resident prefix pages (refcount
+        bump, zero copies), prefill only the tail into freshly
+        allocated pages, seal the new full blocks, and gather the
+        sequence's pages into the host shipment. Wire format is
+        IDENTICAL to the slot path — the decode side never knows which
+        engine produced the rows."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import gpt2_decode as dec
+        from ray_tpu.serve import prefix_cache
+        from ray_tpu.utils.config import config
+
+        mcfg = self.model_cfg
+        T_max = mcfg.n_positions
+        pool = self._pool
+        B = pool.page_tokens
+        max_pages = -(-T_max // B)
+        with self._lock:
+            if self._cache_k is None:
+                self._cache_k, self._cache_v = dec.init_paged_cache(
+                    mcfg, pool.num_pages, B
+                )
+                pool.reset()
+            use_prefix = bool(config.serve_prefix_cache)
+            digests = (
+                prefix_cache.hash_blocks(prompt, B) if use_prefix else []
+            )
+            held_pages: List[int] = []
+            new_pages: List[int] = []
+            try:
+                # keep >=1 prompt token uncached: the tail prefill
+                # produces the first-token logits
+                _, held_pages = pool.match_pages(
+                    digests, max_tokens=len(prompt) - 1
+                )
+                cached = len(held_pages) * B
+                n_pages = -(-len(prompt) // B)
+                alloc = pool.alloc(n_pages - len(held_pages))
+                if alloc is None:
+                    raise RuntimeError(
+                        f"prefill page pool exhausted: need "
+                        f"{n_pages - len(held_pages)} pages"
+                    )
+                new_pages = alloc
+                pages = held_pages + new_pages
+                table = np.zeros((max_pages,), np.int32)
+                table[: len(pages)] = pages
+                tail = prompt[cached:]
+                tok = np.zeros(
+                    (1, bucket(len(tail), max_pages * B - cached)), np.int32
+                )
+                tok[0, : len(tail)] = tail
+                logits, self._cache_k, self._cache_v = dec.prefill_paged(
+                    mcfg, self.params, jnp.asarray(tok), jnp.int32(cached),
+                    jnp.int32(len(tail)), self._cache_k, self._cache_v,
+                    jnp.asarray(table),
+                )
+                first = self._sample_one(logits, temperature)
+                # shipment = gather of this sequence's pages (device
+                # gather + ONE host copy; no per-block host pool copies)
+                n = len(prompt)
+                row_k = np.asarray(
+                    self._cache_k[:, jnp.asarray(table[:n_pages])]
+                ).reshape(mcfg.n_layer, n_pages * B, mcfg.n_head,
+                          mcfg.head_dim)
+                row_v = np.asarray(
+                    self._cache_v[:, jnp.asarray(table[:n_pages])]
+                ).reshape(mcfg.n_layer, n_pages * B, mcfg.n_head,
+                          mcfg.head_dim)
+                n_full = n // B
+                for j in range(len(held_pages), min(n_full, len(digests))):
+                    pool.seal(digests[j], int(pages[j]))
+            except Exception:
+                # prefill donates the caches: a post-dispatch error
+                # leaves them deleted — rebuild (and reset the pool,
+                # whose sealed pages pointed into them) lazily next call
+                self._cache_k = self._cache_v = None
+                raise
+            finally:
+                pool.release_pages(held_pages + new_pages)
         return {
             "k": np.ascontiguousarray(row_k[:, :n]),
             "v": np.ascontiguousarray(row_v[:, :n]),

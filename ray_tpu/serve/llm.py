@@ -42,6 +42,7 @@ class LLMConfig:
         route_prefix: Optional[str] = "/llm",
         max_concurrency: int = 16,
         engine: str = "kv",  # "kv" (cached decode) | "recompute" (legacy)
+        paged_kv: Optional[bool] = None,  # None = RT_SERVE_PAGED_KV
     ):
         self.model_id = model_id
         self.num_replicas = num_replicas
@@ -54,6 +55,12 @@ class LLMConfig:
         if engine not in ("kv", "recompute"):
             raise ValueError(f"unknown engine {engine!r}")
         self.engine = engine
+        # Paged KV pool vs legacy slot cache for the kv engine. An
+        # explicit bool here overrides the RT_SERVE_PAGED_KV env flag —
+        # the config field travels in the pickled deployment spec, so
+        # bench_serve's interleaved A/B arms can pick their engine
+        # without touching replica-process environments.
+        self.paged_kv = paged_kv
 
 
 class _Request:
@@ -90,6 +97,40 @@ class _Request:
             import queue
 
             self.token_q = queue.Queue()
+
+
+class _PagedSeq:
+    """One live sequence in the paged engine: the request it serves,
+    its page pins, and its prefill/decode cursors. Admission reserves
+    EVERY page the sequence can ever touch (ceil(min(prompt+max_new,
+    T_max)/page_tokens)), so the page-table row never changes while the
+    sequence is in flight."""
+
+    __slots__ = ("req", "prompt", "pages", "released", "digests", "n_hit",
+                 "table", "cached_tokens", "prefill_pos", "length",
+                 "produced", "last_token", "t_last", "ttft_us", "active")
+
+    def __init__(self, req: _Request, prompt: List[int]):
+        self.req = req
+        self.prompt = prompt
+        # page pins held in the engine's PagedKVPool: matched prefix
+        # pages first, then freshly allocated ones. Released EXACTLY
+        # once (the ``released`` latch) when the request leaves the
+        # engine — finish, cancel, fail, or unload may race, and a
+        # double release would corrupt another sequence's refcounts.
+        self.pages: List[int] = []
+        self.released = False
+        self.digests: List[str] = []
+        self.n_hit = 0  # leading pages that came from the prefix cache
+        self.table = None  # np [MaxPages] page-table row
+        self.cached_tokens = 0
+        self.prefill_pos = 0  # prompt tokens already in the pool
+        self.length = 0  # tokens in KV once active
+        self.produced: List[int] = []
+        self.last_token = 0
+        self.t_last: Optional[float] = None
+        self.ttft_us = 0
+        self.active = False  # prefill complete, decoding
 
 
 class _Slot:
@@ -146,15 +187,37 @@ class LLMServer:
         self._stop = threading.Event()
         if config.engine == "kv":
             from ray_tpu.serve import prefix_cache
+            from ray_tpu.utils.config import config as rtcfg
 
-            # block pool always exists for a kv engine; the
-            # RT_SERVE_PREFIX_CACHE kill switch is checked per admission
-            # so it doubles as a runtime A/B lever
-            self._prefix_pool: Optional[prefix_cache.BlockPool] = (
-                prefix_cache.BlockPool(config.model_id)
+            self._paged = (
+                bool(config.paged_kv) if config.paged_kv is not None
+                else bool(rtcfg.serve_paged_kv)
             )
-            target = self._engine_loop_kv
+            if self._paged:
+                # ONE page pool holds generation and prefix KV. Default
+                # size is MATCHED MEMORY with the slot engine: the slot
+                # cache is [L, S, T_max, H, Dh]; S*ceil(T_max/B) pages
+                # of B tokens hold the same element count (+1 reserved
+                # scratch page that inactive rows scatter into).
+                B = int(rtcfg.serve_prefix_block_tokens)
+                max_pages = -(-self.model_cfg.n_positions // B)
+                pool_pages = int(rtcfg.serve_kv_pool_pages) or (
+                    config.max_batch_size * max_pages
+                )
+                self._prefix_pool = prefix_cache.PagedKVPool(
+                    config.model_id, num_pages=pool_pages + 1,
+                    page_tokens=B,
+                )
+                target = self._engine_loop_paged
+            else:
+                # legacy slot engine (RT_SERVE_PAGED_KV=0 kill switch):
+                # block pool always exists for a kv engine; the
+                # RT_SERVE_PREFIX_CACHE kill switch is checked per
+                # admission so it doubles as a runtime A/B lever
+                self._prefix_pool = prefix_cache.BlockPool(config.model_id)
+                target = self._engine_loop_kv
         else:
+            self._paged = False
             self._prefix_pool = None
             target = self._engine_loop_recompute
         threading.Thread(
@@ -644,6 +707,454 @@ class LLMServer:
         # stopped (unload): in-flight slots must fail NOW, not strand
         # their callers until the 300s wait times out (unload() drains
         # the queue; slots are this thread's to fail)
+        fail_inflight(
+            RuntimeError(f"engine {self.cfg.model_id!r} was unloaded")
+        )
+        self._occupied = 0
+
+    # -- paged KV engine (one refcounted page pool, chunked prefill) -----
+
+    def _record_step_paged(self, fill: int, pst: Dict[str, int]) -> None:
+        with self._lock:
+            self._batch_sizes.append(fill)
+            self._total_batches += 1
+            self._max_batch_seen = max(self._max_batch_seen, fill)
+            queued = len(self._queue)
+        if core_metrics.ENABLED:
+            dep = self.cfg.model_id
+            core_metrics.serve_batch_fill.observe(
+                fill, tags={"deployment": dep}
+            )
+            ntags = {"deployment": dep, "node": self._node_tag}
+            core_metrics.serve_kv_pages_total.set(
+                pst["pages_total"], tags=ntags
+            )
+            core_metrics.serve_kv_pages_occupied.set(
+                pst["pages_occupied"], tags=ntags
+            )
+            core_metrics.serve_kv_pages_prefix_resident.set(
+                pst["prefix_resident"], tags=ntags
+            )
+            # one-release aliases: page occupancy published under the
+            # slot-gauge names keeps the serve_kv_occupancy alert rule
+            # and pre-paged dashboards evaluating unchanged
+            core_metrics.serve_kv_slots_occupied.set(
+                pst["pages_occupied"], tags=ntags
+            )
+            core_metrics.serve_kv_slots_total.set(
+                pst["pages_total"], tags=ntags
+            )
+            core_metrics.serve_queued_requests.set(queued, tags=ntags)
+
+    def _engine_loop_paged(self) -> None:
+        """Continuous batching over ONE paged KV pool: generation and
+        prefix pages coexist, a prefix hit is a refcount bump (zero
+        copies), admission is page-granular (free pages, not free
+        slots), and long prompts prefill in RT_SERVE_PREFILL_CHUNK_TOKENS
+        chunks interleaved with decode so in-flight streams keep a
+        bounded ITL."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import gpt2_decode as dec
+        from ray_tpu.serve import prefix_cache
+        from ray_tpu.utils.config import config
+
+        mcfg = self.model_cfg
+        T_max = mcfg.n_positions
+        pool = self._prefix_pool
+        B = pool.page_tokens
+        max_pages = -(-T_max // B)  # page-table width per sequence
+        n_phys = pool.num_pages
+        # decode rows: page-granular admission packs more short
+        # sequences than the slot engine had slots, bounded by the pool
+        # itself (every live sequence pins >= 1 page)
+        S = int(config.serve_paged_max_seqs) or min(
+            pool.num_pages - 1, 4 * self.cfg.max_batch_size
+        )
+        S = max(1, min(S, pool.num_pages - 1))
+        cache_k, cache_v = dec.init_paged_cache(mcfg, n_phys, B)
+        seqs: List[Optional[_PagedSeq]] = [None] * S
+        tables = np.zeros((S, max_pages), np.int32)  # 0 rows -> scratch
+        last = np.zeros((S,), np.int32)
+        lengths = np.zeros((S,), np.int32)
+        temps = np.zeros((S,), np.float32)
+        greedy = np.ones((S,), bool)
+        # device-resident step state (incl. page tables): re-uploaded
+        # only when admissions/finishes change it
+        dev_state = None
+        rng_base = self._rng
+        step_no = 0
+
+        def _bucket(n: int, cap: int) -> int:
+            p = 16
+            while p < n:
+                p *= 2
+            return min(p, cap)
+
+        def release_once(s: _PagedSeq) -> None:
+            # pages return to the pool EXACTLY once, however many of
+            # finish/cancel/fail/unload race for this sequence — a
+            # second release would decref pages another sequence may
+            # already have re-allocated
+            if not s.released:
+                s.released = True
+                pages, s.pages = s.pages, []
+                pool.release_pages(pages)
+
+        def retire(i: int) -> None:
+            nonlocal dev_state
+            s = seqs[i]
+            seqs[i] = None
+            release_once(s)
+            tables[i] = 0  # this row's junk scatters -> scratch page
+            lengths[i] = 0
+            dev_state = None
+
+        def activate(i: int, s: _PagedSeq, first: int, kv_len: int) -> None:
+            """Prefill (or import) complete: the sequence joins the
+            decode batch at position ``kv_len`` with ``first`` sampled."""
+            nonlocal dev_state
+            s.active = True
+            s.length = kv_len
+            s.produced = [first]
+            s.last_token = first
+            tables[i] = s.table
+            last[i] = first
+            lengths[i] = kv_len
+            temps[i] = max(s.req.temperature, 1e-6)
+            greedy[i] = s.req.temperature <= 0
+            dev_state = None
+            if tracing.ENABLED and s.req.t0_us:
+                s.ttft_us = tracing.now_us() - s.req.t0_us
+            if core_metrics.ENABLED:
+                now = time.monotonic()
+                s.t_last = now
+                dep_tags = {"deployment": self.cfg.model_id}
+                if s.req.t_enqueue is not None:
+                    core_metrics.serve_ttft_s.observe(
+                        now - s.req.t_enqueue, tags=dep_tags
+                    )
+                core_metrics.serve_tokens_generated.inc(tags=dep_tags)
+            if s.req.token_q is not None and s.req.max_new >= 1:
+                # zero-token completions must not leak the sampled-but-
+                # unrequested first token into the stream
+                s.req.token_q.put(first)
+
+        def import_kv(i: int, s: _PagedSeq, imp: Dict[str, Any]) -> None:
+            """Disaggregated decode: the prefill tier shipped this
+            prompt's KV rows + first token. Blocks already resident in
+            the pool were matched at admission (zero-copy ref bump);
+            only the rest is device-written, then full blocks seal so
+            the NEXT import of this prefix copies nothing at all."""
+            nonlocal cache_k, cache_v
+            n = min(int(imp["prompt_len"]), T_max - 1)
+            skip = min(s.cached_tokens, n)  # pool-resident prefix
+            if n > skip:
+                L, H, Dh = mcfg.n_layer, mcfg.n_head, mcfg.head_dim
+                nblk = -(-(n - skip) // B)
+                kb = np.zeros((L, nblk * B, H, Dh), np.float32)
+                vb = np.zeros((L, nblk * B, H, Dh), np.float32)
+                kb[:, : n - skip] = np.asarray(imp["k"])[:, skip:n]
+                vb[:, : n - skip] = np.asarray(imp["v"])[:, skip:n]
+                first_pg = skip // B
+                pages = np.asarray(
+                    s.pages[first_pg : first_pg + nblk], np.int32
+                )
+                cache_k, cache_v = dec.write_pages(
+                    jnp.asarray(kb.reshape(L, nblk, B, H, Dh)),
+                    jnp.asarray(vb.reshape(L, nblk, B, H, Dh)),
+                    cache_k, cache_v, jnp.asarray(pages),
+                )
+                pool.copies += nblk
+                if core_metrics.ENABLED:
+                    core_metrics.serve_kv_block_copies.inc(
+                        nblk, tags={"deployment": self.cfg.model_id}
+                    )
+                for j in range(first_pg, min(n // B, len(s.digests))):
+                    pool.seal(s.digests[j], int(s.pages[j]))
+            s.prefill_pos = len(s.prompt)
+            s.cached_tokens = n
+            activate(i, s, int(imp["first_token"]), n)
+
+        def admit(i: int, req: _Request) -> bool:
+            """Page-based admission: reserve EVERY page the sequence
+            can ever touch up front (tables never change mid-flight,
+            decode can never OOM mid-generation). Returns False — and
+            takes nothing — when the pool can't cover the reservation:
+            the caller requeues the request until pages free up."""
+            nonlocal cache_k, cache_v
+            prompt = req.prompt[-(T_max - 1):]
+            use_prefix = bool(config.serve_prefix_cache)
+            total_tokens = min(len(prompt) + req.max_new, T_max)
+            n_pages = -(-total_tokens // B)
+            if n_pages > pool.num_pages - 1:
+                self._fail_request(req, RuntimeError(
+                    f"request needs {n_pages} KV pages; pool has "
+                    f"{pool.num_pages - 1}"
+                ))
+                return True  # consumed (failed); keep admitting
+            digests = (
+                prefix_cache.hash_blocks(prompt, B) if use_prefix else []
+            )
+            if req.kv_import is not None:
+                cap = int(req.kv_import["prompt_len"])
+            else:
+                # keep >=1 prompt token uncached: the tail prefill
+                # produces the first-token logits
+                cap = len(prompt) - 1
+            _, hit_pages = pool.match_pages(digests, max_tokens=cap)
+            new_pages = pool.alloc(n_pages - len(hit_pages))
+            if new_pages is None:
+                pool.release_pages(hit_pages)
+                return False
+            s = _PagedSeq(req, prompt)
+            s.pages = hit_pages + new_pages
+            s.digests = digests
+            s.n_hit = len(hit_pages)
+            s.cached_tokens = len(hit_pages) * B
+            s.prefill_pos = s.cached_tokens
+            row = np.zeros((max_pages,), np.int32)
+            row[: len(s.pages)] = s.pages
+            s.table = row
+            seqs[i] = s
+            try:
+                if req.kv_import is not None:
+                    import_kv(i, s, req.kv_import)
+            except Exception as e:  # noqa: BLE001
+                retire(i)
+                self._fail_request(req, e)
+                # write_pages donates the caches: a post-dispatch
+                # failure here deleted them — propagate so the outer
+                # handler fails in-flight requests and rebuilds
+                raise
+            return True
+
+        def run_prefill() -> None:
+            """Chunked prefill: at most RT_SERVE_PREFILL_CHUNK_TOKENS
+            prompt tokens per engine round (0 = unchunked), so a long
+            prompt prefills across rounds interleaved with decode steps
+            and in-flight streams keep a bounded ITL."""
+            nonlocal cache_k, cache_v
+            chunk = int(config.serve_prefill_chunk_tokens)
+            budget = chunk if chunk > 0 else (1 << 30)
+            for i in range(S):
+                s = seqs[i]
+                if s is None or s.active or s.req.cancelled:
+                    continue
+                if budget <= 0:
+                    break
+                logits = None
+                while s.prefill_pos < len(s.prompt) and budget > 0:
+                    start = s.prefill_pos
+                    n = min(len(s.prompt) - start, budget)
+                    width = _bucket(n, max_pages * B - start)
+                    n = min(n, width)
+                    tok = np.zeros((1, width), np.int32)
+                    tok[0, :n] = s.prompt[start : start + n]
+                    logits, cache_k, cache_v = dec.prefill_paged(
+                        mcfg, self.params, jnp.asarray(tok),
+                        jnp.int32(start), jnp.int32(n),
+                        cache_k, cache_v, jnp.asarray(s.table),
+                    )
+                    s.prefill_pos = start + n
+                    budget -= n
+                if s.prefill_pos >= len(s.prompt) and logits is not None:
+                    # full prompt blocks this sequence just wrote become
+                    # shareable prefix pages: seal registers the page
+                    # under its chain digest with NO copy
+                    n_full = len(s.prompt) // B
+                    for j in range(s.n_hit, min(n_full, len(s.digests))):
+                        pool.seal(s.digests[j], int(s.pages[j]))
+                    first = self._sample_one(logits, s.req.temperature)
+                    activate(i, s, int(first), len(s.prompt))
+
+        def finish(i: int) -> None:
+            s = seqs[i]
+            retire(i)
+            s.req.result = s.produced[: s.req.max_new]
+            if tracing.ENABLED and s.req.trace_id and s.req.t0_us:
+                tracing.emit(tracing.request_span(
+                    s.req.trace_id, tracing.ENGINE, self.cfg.model_id,
+                    s.req.t0_us, tracing.now_us() - s.req.t0_us,
+                    tokens=len(s.req.result),
+                    cached=s.cached_tokens > 0, ttft_us=s.ttft_us,
+                ))
+            s.req.event.set()
+            if s.req.token_q is not None:
+                s.req.token_q.put(None)  # end of stream
+
+        def fail_inflight(e: BaseException) -> None:
+            for i in range(S):
+                if seqs[i] is not None:
+                    s = seqs[i]
+                    retire(i)
+                    self._fail_request(s.req, e)
+
+        def one_round() -> None:
+            nonlocal cache_k, cache_v, dev_state, step_no
+            if cache_k is None:
+                # rebuild after a poisoned (donated) round. The pool's
+                # sealed pages pointed into the deleted cache, so ALL
+                # pool metadata resets with it (the BlockPool kept host
+                # copies and could survive this; the page pool cannot)
+                cache_k, cache_v = dec.init_paged_cache(mcfg, n_phys, B)
+                pool.reset()
+            # reap abandoned requests: their pages go back to the pool
+            # instead of decoding to max_new for nobody
+            for i in range(S):
+                s = seqs[i]
+                if s is not None and s.req.cancelled:
+                    retire(i)
+                    s.req.event.set()
+            admitted = False
+            for i in range(S):
+                if seqs[i] is not None:
+                    continue
+                while True:
+                    with self._lock:
+                        req = self._queue.popleft() if self._queue else None
+                    if req is None or not req.cancelled:
+                        break
+                    req.event.set()  # cancelled while queued: never admit
+                if req is None:
+                    break
+                if not admit(i, req):
+                    # page pressure: requeue at the FRONT (FIFO order
+                    # holds) and stop admitting until pages free up
+                    with self._lock:
+                        self._queue.appendleft(req)
+                    break
+                admitted = True
+            run_prefill()
+            prefilling = any(
+                s is not None and not s.active for s in seqs
+            )
+            active = [
+                i for i in range(S)
+                if seqs[i] is not None and seqs[i].active
+            ]
+            # single-token answers (and 0-token asks) finish immediately
+            for i in list(active):
+                s = seqs[i]
+                if len(s.produced) >= s.req.max_new or s.length >= T_max - 1:
+                    finish(i)
+            active = [
+                i for i in range(S)
+                if seqs[i] is not None and seqs[i].active
+            ]
+            self._occupied = len(active)
+            if not active:
+                if not admitted and not prefilling:
+                    self._work.wait(timeout=0.5)
+                    self._work.clear()
+                return
+            if dev_state is None:
+                dev_state = (
+                    jnp.asarray(last), jnp.asarray(lengths),
+                    jnp.asarray(temps), jnp.asarray(greedy),
+                    jnp.asarray(tables),
+                )
+            d_last, d_len, d_temps, d_greedy, d_tables = dev_state
+            # Chunk size: single-step while requests wait for admission
+            # OR any sequence is mid-prefill (the next prefill chunk
+            # must interleave after ONE decode step, or ITL for live
+            # streams would stretch by the whole chunk).
+            with self._lock:
+                waiting = bool(self._queue)
+            K = 1
+            if not waiting and not prefilling:
+                K = min(
+                    8,
+                    min(
+                        min(
+                            seqs[i].req.max_new - len(seqs[i].produced),
+                            T_max - 1 - seqs[i].length,
+                        )
+                        for i in active
+                    ),
+                )
+                K = max(K, 1)
+            self._record_step_paged(len(active), pool.stats())
+            if K > 1:
+                toks_dev, d_last2, d_len, cache_k, cache_v = (
+                    dec.decode_multi_paged(
+                        mcfg, self.params, d_last, d_len, cache_k,
+                        cache_v, d_tables, d_temps, d_greedy, rng_base,
+                        K, step_no,
+                    )
+                )
+                step_no += K
+                dev_state = (d_last2, d_len, d_temps, d_greedy, d_tables)
+                toks = np.asarray(toks_dev)  # [K, S]
+            else:
+                step_no += 1
+                nxt_dev, d_len, cache_k, cache_v = (
+                    dec.decode_paged_and_sample(
+                        mcfg, self.params, d_last, d_len, cache_k,
+                        cache_v, d_tables, d_temps, d_greedy, rng_base,
+                        step_no,
+                    )
+                )
+                dev_state = (nxt_dev, d_len, d_temps, d_greedy, d_tables)
+                toks = np.asarray(nxt_dev)[None]  # [1, S]
+            if core_metrics.ENABLED:
+                now = time.monotonic()
+                n_new = toks.shape[0]
+                dep_tags = {"deployment": self.cfg.model_id}
+                core_metrics.serve_tokens_generated.inc(
+                    n_new * len(active), tags=dep_tags
+                )
+                for i in active:
+                    s = seqs[i]
+                    if s is None:
+                        continue
+                    if s.t_last is not None:
+                        core_metrics.serve_inter_token_s.observe(
+                            (now - s.t_last) / n_new, tags=dep_tags
+                        )
+                    s.t_last = now
+            for k in range(toks.shape[0]):
+                for i in active:
+                    s = seqs[i]
+                    if s is None:  # finished at an earlier k of this chunk
+                        continue
+                    s.length += 1
+                    s.last_token = int(toks[k, i])
+                    s.produced.append(s.last_token)
+                    if (
+                        s.req.token_q is not None
+                        and len(s.produced) > 1  # first sent at activate
+                        and len(s.produced) <= s.req.max_new
+                    ):
+                        s.req.token_q.put(s.last_token)
+                    last[i] = s.last_token
+                    lengths[i] = s.length
+                    if (
+                        len(s.produced) >= s.req.max_new
+                        or s.length >= T_max - 1
+                    ):
+                        finish(i)  # retire() resets dev_state
+
+        while not self._stop.is_set():
+            try:
+                one_round()
+            except Exception as e:  # noqa: BLE001 — engine must survive
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "paged kv engine round failed; failing in-flight"
+                    " requests"
+                )
+                fail_inflight(e)
+                dev_state = None
+                # prefill/decode/write donate the caches: an exception
+                # after dispatch leaves them deleted — mark for rebuild
+                # (done inside the next round's try, with a pool.reset
+                # alongside, so a failing rebuild can't kill the thread)
+                cache_k = cache_v = None
+                time.sleep(0.05)  # don't hot-spin on a persistent fault
         fail_inflight(
             RuntimeError(f"engine {self.cfg.model_id!r} was unloaded")
         )

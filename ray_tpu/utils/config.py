@@ -262,6 +262,28 @@ config.define("serve_prefix_block_tokens", 64)
 # Max resident blocks per engine pool; refcount-0 blocks evict LRU
 # beyond this.
 config.define("serve_prefix_pool_blocks", 512)
+# Paged KV pool (serve/llm.py + prefix_cache.PagedKVPool): the engine's
+# generation KV and the prefix cache share ONE block-granular refcounted
+# page pool — a prefix hit is a refcount bump (zero block copies),
+# eviction is global LRU over pages not pinned by a live request, and
+# continuous batching admits by free PAGES instead of free slots.
+# RT_SERVE_PAGED_KV=0 is the kill switch: the engine reverts to the
+# pre-paged slot cache + copy-based BlockPool (and the A/B lever for
+# bench_serve's pagedkv leg). Page size inherits
+# serve_prefix_block_tokens so page identity == prefix-block identity.
+config.define("serve_paged_kv", True)
+# Total pages in the engine pool; 0 = auto-size to MATCHED MEMORY with
+# the slot engine (max_batch_size x ceil(n_positions/page_tokens)).
+config.define("serve_kv_pool_pages", 0)
+# Max concurrent sequences the paged engine decodes per step (the
+# static batch width of the jitted decode); 0 = auto
+# (4 x max_batch_size, capped by the pool's page count).
+config.define("serve_paged_max_seqs", 0)
+# Chunked prefill: at most this many prompt tokens are prefilled per
+# engine round, so one long prompt is spread across rounds interleaved
+# with decode steps (bounding in-flight streams' ITL and per-step
+# memory). 0 = unchunked (a prompt prefills in one round).
+config.define("serve_prefill_chunk_tokens", 512)
 # Disaggregated prefill/decode (serve/kv_transfer.py): the ingress
 # calls a separate prefill deployment which ships the slot's KV rows
 # back over an RpcChannel (zero-copy multiseg frames); the local engine

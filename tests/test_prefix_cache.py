@@ -193,6 +193,11 @@ def test_refcounts_drain_under_slot_churn(engine):
     pool = engine._prefix_pool
     assert pool.resident() > 0
     with pool._lock:
-        assert all(b.refs == 0 for b in pool._blocks.values()), {
-            b.digest: b.refs for b in pool._blocks.values() if b.refs
-        }
+        if hasattr(pool, "_pages"):  # PagedKVPool (paged engine default)
+            assert all(p.refs == 0 for p in pool._pages), {
+                p.idx: p.refs for p in pool._pages if p.refs
+            }
+        else:  # BlockPool (RT_SERVE_PAGED_KV=0 slot engine)
+            assert all(b.refs == 0 for b in pool._blocks.values()), {
+                b.digest: b.refs for b in pool._blocks.values() if b.refs
+            }
