@@ -66,6 +66,152 @@ def _bench_serve_http():
     return per_s, per_s_raw
 
 
+def _bench_train_overlap(record, ray_tpu, np):
+    """DP train-step A/B: overlapped bucketed grad_sync vs
+    compute-then-allreduce on the SAME ranks, interleaved per round —
+    plus the hierarchical inter-host byte A/B on 8 ranks spread over 2
+    virtual hosts (interleaved placement, so every flat-ring hop
+    crosses hosts and the measured reduction is the honest worst case).
+    """
+
+    @ray_tpu.remote
+    class TrainRank:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def setup(self, group, world, host=None):
+            from ray_tpu import collective
+            from ray_tpu.utils.config import config
+
+            if host is not None:
+                config.set("collective_host_id", host)
+            collective.init_collective_group(world, self.rank, "cpu", group)
+            return True
+
+        def destroy(self, group):
+            from ray_tpu import collective
+
+            collective.destroy_collective_group(group)
+            return True
+
+        def reset_stats(self):
+            from ray_tpu.collective import p2p
+
+            return p2p.reset_stats()
+
+        def step(self, group, parts, leaves, n_leaf, dim, iters,
+                 overlapped):
+            """One DP step: per-part backward compute (matmul chain)
+            producing ``leaves`` gradient leaves of 4*n_leaf bytes each.
+            Baseline is the pre-grad_sync DP loop: all compute, then one
+            BLOCKING allreduce per leaf. Overlapped pushes each part's
+            leaves as they are produced — they coalesce into one bucket
+            per part on the comm lane — and joins at the end. Returns
+            (wall_s, comm_hidden_frac)."""
+            import time as time_mod
+
+            from ray_tpu import collective
+            from ray_tpu.collective import bucketed
+
+            rng = np.random.default_rng(self.rank)
+            grads = [[rng.standard_normal(n_leaf).astype(np.float32)
+                      for _ in range(leaves)] for _ in range(parts)]
+            a = rng.standard_normal((dim, dim)).astype(np.float32)
+
+            def compute():
+                b = a
+                for _ in range(iters):
+                    b = b @ a
+                return float(b[0, 0])
+
+            t0 = time_mod.perf_counter()
+            hidden = 0.0
+            if overlapped:
+                h = bucketed.GradSync(group, average=False,
+                                      bucket_bytes=leaves * n_leaf * 4)
+                for part in grads:
+                    compute()
+                    h.push(part)  # grads hit the wire mid-backward
+                h.join()
+                hidden = h.stats.get("hidden_frac", 0.0)
+            else:
+                for _ in grads:
+                    compute()
+                for part in grads:
+                    for g in part:
+                        collective.allreduce(g, group_name=group)
+            return time_mod.perf_counter() - t0, hidden
+
+        def sync_one(self, group, n, hierarchy):
+            from ray_tpu.collective import bucketed
+
+            g = np.full(n, 1.0 + self.rank, dtype=np.float32)
+            bucketed.grad_sync({"g": g}, group_name=group, average=False,
+                               hierarchy=hierarchy).join()
+            return True
+
+    # -- overlap A/B: 4 ranks, 6 parts x 8 leaves x 128 KiB per step ----
+    world = 4
+    tranks = [TrainRank.remote(i) for i in range(world)]
+    ray_tpu.get([r.setup.remote("bench_gs", world) for r in tranks],
+                timeout=120)
+    parts, leaves, n_leaf, dim, iters = 6, 8, 32768, 384, 2
+
+    def _round(overlapped):
+        t0 = time.perf_counter()
+        outs = ray_tpu.get(
+            [r.step.remote("bench_gs", parts, leaves, n_leaf, dim, iters,
+                           overlapped)
+             for r in tranks],
+            timeout=600,
+        )
+        return time.perf_counter() - t0, outs
+
+    _round(True)
+    _round(False)  # warm both paths
+    seq_l, ov_l, hidden = [], [], []
+    for _ in range(3):
+        wall, outs = _round(True)
+        ov_l.append(wall)
+        hidden.append(max(h for _, h in outs))
+        wall, _ = _round(False)
+        seq_l.append(wall)
+    record("train_step_perleaf_ms", min(seq_l) * 1e3, "ms")
+    record("train_step_overlap_ms", min(ov_l) * 1e3, "ms")
+    record("train_step_overlap_speedup", min(seq_l) / min(ov_l), "x")
+    record("train_step_comm_hidden_pct", 100 * max(hidden), "%")
+    ray_tpu.get([r.destroy.remote("bench_gs") for r in tranks], timeout=60)
+
+    # -- hierarchical inter-host bytes: 8 ranks on 2 virtual hosts ------
+    world_h = 8
+    hranks = [TrainRank.remote(i) for i in range(world_h)]
+    ray_tpu.get(
+        [r.setup.remote("bench_hier", world_h, f"h{i % 2}")
+         for i, r in enumerate(hranks)],
+        timeout=120,
+    )
+    n_h = 1024 * 1024  # 4 MiB f32
+    ray_tpu.get([r.sync_one.remote("bench_hier", n_h, "flat")
+                 for r in hranks], timeout=600)  # warmup
+    inter = {}
+    for mode in ("flat", "two_level"):
+        ray_tpu.get([r.reset_stats.remote() for r in hranks])
+        t0 = time.perf_counter()
+        ray_tpu.get([r.sync_one.remote("bench_hier", n_h, mode)
+                     for r in hranks], timeout=600)
+        lat = time.perf_counter() - t0
+        stats = ray_tpu.get([r.reset_stats.remote() for r in hranks])
+        inter[mode] = sum(s["bytes_sent_inter"] for s in stats)
+        record(f"coll_hier_4mb_8rank_{mode}_ms", lat * 1e3, "ms")
+    record("coll_hier_inter_host_bytes_flat", inter["flat"], "bytes")
+    record("coll_hier_inter_host_bytes_2level", inter["two_level"],
+           "bytes")
+    record("coll_hier_inter_reduction",
+           inter["flat"] / max(1, inter["two_level"]), "x")
+    ray_tpu.get([r.destroy.remote("bench_hier") for r in hranks],
+                timeout=60)
+
+
 def main():
     import numpy as np
 
@@ -382,6 +528,9 @@ def main():
            min(kv_lats) / min(p2p_lats), "x")
     del ranks, ab
 
+    # -- overlapped bucketed grad sync + hierarchical collectives -------
+    _bench_train_overlap(record, ray_tpu, np)
+
     # -- RDT device objects vs pickle path ------------------------------
     import jax
 
@@ -524,5 +673,36 @@ def main():
     ray_tpu.shutdown()
 
 
+def train_overlap_only():
+    """Run just the grad-sync leg, merging its rows into an existing
+    BENCH_CORE.json (python bench_core.py --train-overlap-only)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.core import cluster_utils
+
+    swept = cluster_utils.sweep_stale_runtime()
+    if swept["killed"] or swept["removed"]:
+        print(json.dumps({"swept_stale_runtime": swept}), flush=True)
+    ray_tpu.init(num_cpus=32)
+    results = {}
+    if os.path.exists("BENCH_CORE.json"):
+        with open("BENCH_CORE.json") as f:
+            results = json.load(f)
+
+    def record(name, value, unit="calls/s"):
+        results[name] = {"value": round(value, 1), "unit": unit}
+        print(json.dumps({"metric": name, "value": round(value, 1),
+                          "unit": unit}), flush=True)
+
+    _bench_train_overlap(record, ray_tpu, np)
+    with open("BENCH_CORE.json", "w") as f:
+        json.dump(results, f, indent=2)
+    ray_tpu.shutdown()
+
+
 if __name__ == "__main__":
-    main()
+    if "--train-overlap-only" in sys.argv:
+        train_overlap_only()
+    else:
+        main()

@@ -192,6 +192,18 @@ def _render_top(mx: dict, reqs: dict, qps: Optional[dict],
         f"profiling: {prof}  |  task stalls {int(stalls)}"
         + ("  <-- hung tasks flagged; run `rt stacks`" if stalls else "")
     )
+    # -- bucketed grad sync (one line; only once a grad_sync has run) --
+    overlap = metric("rt_collective_overlap_hidden_frac")["series"].values()
+    ov_count = sum(v["count"] for v in overlap)
+    ov_sum = sum(v["sum"] for v in overlap)
+    bucket_b = scalar_sum("rt_collective_bucket_bytes_total")
+    inter_b = scalar_sum("rt_collective_inter_host_bytes_total")
+    if ov_count or bucket_b or inter_b:
+        hidden = f"{ov_sum / ov_count * 100:.0f}%" if ov_count else "-"
+        out.append(
+            f"collectives: comm hidden {hidden} avg  |  bucket bytes "
+            f"{int(bucket_b):,}  |  inter-host bytes {int(inter_b):,}"
+        )
 
     # -- serve: one row per deployment --
     rows: dict = {}

@@ -33,19 +33,23 @@ from ray_tpu.collective.collective import (
     reducescatter,
     send,
 )
+from ray_tpu.collective.bucketed import GradSync, allreduce_async, grad_sync
 from ray_tpu.collective.xla_group import get_xla_coordinator, xla_coordinator_env
 
 __all__ = [
     "CollectiveError",
+    "GradSync",
     "ReduceOp",
     "allgather",
     "allreduce",
+    "allreduce_async",
     "barrier",
     "broadcast",
     "destroy_collective_group",
     "get_collective_group_size",
     "get_rank",
     "get_xla_coordinator",
+    "grad_sync",
     "init_collective_group",
     "recv",
     "reducescatter",

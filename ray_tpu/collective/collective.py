@@ -156,6 +156,8 @@ def destroy_collective_group(group_name: str = "default") -> None:
     token dies with it, so in-flight deliveries from old peers are
     dropped on arrival."""
     group = _groups.pop(group_name, None)
+    from ray_tpu.collective import bucketed  # local import — avoids cycle
+    bucketed.shutdown_lane(group_name)
     p2p.drop_group(group_name)
     try:
         _control().call_oneway("kv_del_prefix", ns=f"coll/{group_name}", prefix="")

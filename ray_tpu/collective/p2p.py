@@ -71,7 +71,8 @@ from ray_tpu.utils.config import config
 # through actor methods (each rank is its own process) to pin wire-byte
 # claims — quantized vs f32, p2p-vs-KV routing — independent of the
 # metrics pipeline; core metrics mirror the send side when enabled.
-stats = {"bytes_sent": 0, "bytes_recv": 0, "sends": 0, "delivers": 0}
+stats = {"bytes_sent": 0, "bytes_recv": 0, "sends": 0, "delivers": 0,
+         "bytes_sent_inter": 0}
 _stats_lock = threading.Lock()
 
 _DELIVER = "coll_deliver"
@@ -107,8 +108,8 @@ class _P2PGroup:
         self.world_size = world_size
         self.rank = rank
         self.token = token  # my incarnation id (published at rendezvous)
-        # rank -> (worker rpc address, incarnation token)
-        self.peers: List[Tuple[str, str]] = []
+        # rank -> (worker rpc address, incarnation token, host id)
+        self.peers: List[Tuple[str, str, str]] = []
         self.mailbox: Dict[str, Any] = {}
         self.seen: set = set()
         self.seen_order: deque = deque()
@@ -140,6 +141,21 @@ def group_for(name: str) -> Optional[_P2PGroup]:
         return _groups.get(name)
 
 
+def host_id() -> str:
+    """This process's host identity for collective topology: the
+    collective_host_id override (tests/bench model multi-host placement
+    on one box with it) or the worker address host."""
+    hid = str(config.collective_host_id or "")
+    if hid:
+        return hid
+    addr = getattr(_worker(), "address", "") or ""
+    return addr.rsplit(":", 1)[0] or "localhost"
+
+
+def host_of(g: _P2PGroup, rank: int) -> str:
+    return g.peers[rank][2]
+
+
 # ---------------------------------------------------------------------------
 # rendezvous / teardown
 # ---------------------------------------------------------------------------
@@ -166,7 +182,7 @@ def setup_group(name: str, world_size: int, rank: int,
     with _groups_lock:
         _groups[name] = g
     ns = f"coll/{name}"
-    payload = serialization.dumps((w.address, token))
+    payload = serialization.dumps((w.address, token, host_id()))
     try:
         w.control.call(  # inband: ok — ~100 B rendezvous record, not data
             "kv_put", ns=ns, key=f"p2p/{rank}", value=payload,
@@ -176,7 +192,7 @@ def setup_group(name: str, world_size: int, rank: int,
             w.control, ns, [f"p2p/{r}" for r in range(world_size)],
             timeout_s,
         )
-        peers: List[Tuple[str, str]] = []
+        peers: List[Tuple[str, str, str]] = []
         missing = []
         for r in range(world_size):
             val = out.get(f"p2p/{r}")
@@ -300,13 +316,21 @@ def send_async(g: _P2PGroup, dst: int, tag: str, payload,
     reduce. Returns a handle for reap(). ndarray / (int8, scales) tuple
     payloads ride as raw out-of-band segments."""
     nbytes = _payload_nbytes(payload)
+    # hierarchical-mode accounting: a delivery whose destination host
+    # differs from ours crossed a host boundary (with collective_host_id
+    # overrides this models multi-host placement even on one box)
+    inter = bool(g.peers) and g.peers[dst][2] != g.peers[g.rank][2]
     with _stats_lock:
         stats["bytes_sent"] += nbytes
         stats["sends"] += 1
+        if inter:
+            stats["bytes_sent_inter"] += nbytes
     if core_metrics.ENABLED:
         core_metrics.collective_bytes_sent.inc(
             nbytes, tags={"op": op, "transport": "p2p"}
         )
+        if inter:
+            core_metrics.collective_inter_bytes.inc(nbytes, tags={"op": op})
     # chaos parity with RpcClient.call: call_async has no injection
     # point, so the collective transport rolls its own. An injected
     # request drop models a torn send the SENDER sees immediately — the
@@ -520,15 +544,24 @@ def _flat_chunks(acc: np.ndarray, world: int) -> List[np.ndarray]:
 
 def ring_allreduce(g: _P2PGroup, arr: np.ndarray, op: str, tag: str,
                    quant: Optional[str] = None,
-                   timeout_s: Optional[float] = None) -> np.ndarray:
+                   timeout_s: Optional[float] = None,
+                   ring: Optional[List[int]] = None) -> np.ndarray:
     """Pipelined ring allreduce: reduce-scatter then allgather, each
     ring chunk split into subchunks so the wire and the local reduce
     overlap. With quant="int8" (SUM over floats only) every wire payload
     is blockwise-int8; accumulation stays f32 and forwarded allgather
     payloads are passed on verbatim, so each final chunk is quantized
-    exactly once."""
+    exactly once.
+
+    ``ring`` restricts the op to an ordered subset of the group's ranks
+    (every member must pass the SAME list, and this rank must be in it)
+    — the hierarchical two-level mode runs its inter-host phase as a
+    ring over host leaders only this way."""
     deadline = _deadline(timeout_s)
     shape, dtype = arr.shape, arr.dtype
+    members = ring if ring is not None else list(range(g.world_size))
+    world = len(members)
+    pos = members.index(g.rank)
     if quant is not None:
         if quant != "int8":
             raise ValueError(f"unsupported quant mode {quant!r}")
@@ -543,22 +576,23 @@ def ring_allreduce(g: _P2PGroup, arr: np.ndarray, op: str, tag: str,
         )
     else:
         acc = np.ascontiguousarray(arr).reshape(-1).copy()
+    if world < 2:
+        return acc.astype(dtype, copy=False).reshape(shape)
     n0 = acc.size
-    world = g.world_size
     pad = (-n0) % world
     if pad:
         acc = np.concatenate([acc, np.zeros(pad, dtype=acc.dtype)])
     chunks = _flat_chunks(acc, world)
-    nxt = (g.rank + 1) % world
+    nxt = members[(pos + 1) % world]
     red = _INPLACE_REDUCERS[op]
 
-    # phase 1: reduce-scatter — after world-1 steps rank r owns the
-    # fully-reduced chunk (r+1) % world
+    # phase 1: reduce-scatter — after world-1 steps ring position p owns
+    # the fully-reduced chunk (p+1) % world
     for step in range(world - 1):
         if _step_hook is not None:
             _step_hook("rs", step)
-        si = (g.rank - step) % world
-        ri = (g.rank - step - 1) % world
+        si = (pos - step) % world
+        ri = (pos - step - 1) % world
         handles = [
             send_async(g, nxt, f"{tag}/rs{step}/{j}",
                        _encode(sub, quant), op="allreduce")
@@ -574,7 +608,7 @@ def ring_allreduce(g: _P2PGroup, arr: np.ndarray, op: str, tag: str,
     # phase 2: allgather — forward received payloads VERBATIM (quantized
     # chunks are quantized once by their owner, dequantized once here)
     carry = []
-    for sub in _subchunks(chunks[(g.rank + 1) % world]):
+    for sub in _subchunks(chunks[(pos + 1) % world]):
         payload = _encode(sub, quant)
         if quant is not None:
             # the owner adopts the same quantization loss it ships:
@@ -584,7 +618,7 @@ def ring_allreduce(g: _P2PGroup, arr: np.ndarray, op: str, tag: str,
             np.copyto(sub, _decode(payload, quant), casting="unsafe")
         carry.append(payload)
     for step in range(world - 1):
-        ri = (g.rank - step) % world
+        ri = (pos - step) % world
         handles = [
             send_async(g, nxt, f"{tag}/ag{step}/{j}", payload,
                        op="allreduce")

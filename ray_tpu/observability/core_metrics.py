@@ -255,6 +255,27 @@ def _build() -> dict:
             boundaries=_LATENCY_BOUNDS,
             tag_keys=("op",),
         ),
+        # -- bucketed grad sync (collective/bucketed.py) --
+        "collective_overlap_hidden_frac": Histogram(
+            "rt_collective_overlap_hidden_frac",
+            "fraction of grad_sync bucket comm time hidden behind caller "
+            "compute, from joining bucket spans against the window before "
+            "join() (1.0 = fully overlapped)",
+            boundaries=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8,
+                        0.9),
+        ),
+        "collective_bucket_bytes": Counter(
+            "rt_collective_bucket_bytes_total",
+            "gradient bytes shipped through bucketed grad_sync, by "
+            "transport (flat ring / two-level hierarchical / KV fallback)",
+            tag_keys=("transport",),
+        ),
+        "collective_inter_bytes": Counter(
+            "rt_collective_inter_host_bytes_total",
+            "collective payload bytes whose ring delivery crossed a host "
+            "boundary (destination host differs from the sender's)",
+            tag_keys=("op",),
+        ),
         # -- task event buffer (worker.py) --
         "task_events_dropped": Counter(
             "rt_task_events_dropped_total",

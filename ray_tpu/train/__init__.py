@@ -14,7 +14,7 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
-from ray_tpu.train.context import get_context, get_dataset_shard, report
+from ray_tpu.train.context import get_context, get_dataset_shard, grad_sync, report
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer
 
 __all__ = [
@@ -29,5 +29,6 @@ __all__ = [
     "ScalingConfig",
     "get_context",
     "get_dataset_shard",
+    "grad_sync",
     "report",
 ]

@@ -79,6 +79,33 @@ class TrainContext:
             )
         return ds.iterator()
 
+    def grad_sync(self, grads=None, *, average: bool = True,
+                  quant: Optional[str] = None,
+                  bucket_bytes: Optional[int] = None,
+                  hierarchy: Optional[str] = None,
+                  timeout_s: Optional[float] = None):
+        """Overlapped bucketed DP gradient allreduce on this worker's
+        collective group. ``grads = ctx.grad_sync(grads).join()`` is the
+        one-shot form; for overlap, take an open handle before backward
+        (``h = ctx.grad_sync()``), ``h.push(...)`` per microbatch/stage,
+        and ``h.join()`` at optimizer apply. Single-worker runs (or no
+        collective group) pass through locally. Averages by world size
+        by default — the DP convention."""
+        from ray_tpu.collective import bucketed
+
+        group = (
+            self.collective_group if self.world_size > 1 else None
+        )
+        handle = bucketed.GradSync(
+            group, average=average, quant=quant,
+            bucket_bytes=bucket_bytes, hierarchy=hierarchy,
+            timeout_s=timeout_s,
+        )
+        if grads is not None:
+            handle.push(grads)
+            handle.close()
+        return handle
+
 
 def set_context(ctx: Optional[TrainContext]) -> None:
     _local.ctx = ctx
@@ -95,6 +122,11 @@ def get_context() -> TrainContext:
 
 def get_dataset_shard(name: str = "train"):
     return get_context().get_dataset_shard(name)
+
+
+def grad_sync(grads=None, **kwargs):
+    """Module-level convenience for ``get_context().grad_sync(...)``."""
+    return get_context().grad_sync(grads, **kwargs)
 
 
 def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None) -> None:

@@ -220,6 +220,24 @@ config.define("collective_chunk_bytes", 1 * 1024 * 1024)
 config.define("collective_op_timeout_s", 120.0)
 # Quantized allreduce (quant="int8"): elements per blockwise f32 scale.
 config.define("collective_quant_block", 2048)
+# Overlapped bucketed gradient allreduce (collective/bucketed.py):
+# grad_sync packs the gradient pytree into per-dtype byte buckets (in
+# reverse leaf order — backward produces output-side grads first) and
+# allreduces each bucket on a background comm lane, joining only at
+# optimizer apply. RT_COLLECTIVE_BUCKETED=0 is the kill switch: grad_sync
+# degrades to the per-leaf blocking allreduce path.
+config.define("collective_bucketed", True)
+config.define("collective_bucket_bytes", 4 * 1024 * 1024)
+# Hierarchical two-level allreduce: when a group spans >1 host (and has
+# more ranks than hosts), bucketed allreduce reduces intra-host to a
+# leader, runs the ring over leaders only, and broadcasts back — wire
+# bytes crossing hosts scale with hosts, not ranks. 0 = always flat ring.
+config.define("collective_hierarchical", True)
+# Per-process host identity override for the collective topology (used
+# by tests/bench to model multi-host placement on one box; empty = the
+# worker address host). Dynamic: per-process, never shipped in the head
+# config snapshot.
+config.define("collective_host_id", "", dynamic=True)
 # Compiled pipeline (parallel/pipeline.py CompiledPipeline): force EVERY
 # stage-boundary channel onto the cross-host RpcChannel tier even when
 # the stages share a node — the test/A-B lever for the worker<->worker

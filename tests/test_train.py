@@ -3,7 +3,6 @@ failure recovery (parity model: python/ray/train tests, BASELINE config 1)."""
 
 import os
 
-import numpy as np
 import pytest
 
 import ray_tpu
@@ -31,7 +30,6 @@ def _mlp_train_fn(config):
     import pickle
 
     import ray_tpu.train as train
-    from ray_tpu import collective
     from ray_tpu.models import mlp
 
     ctx = train.get_context()
@@ -62,14 +60,9 @@ def _mlp_train_fn(config):
     lr = config["lr"]
     for step in range(start_step, config["steps"]):
         loss, grads = grad_fn(params, (x, y))
-        # data-parallel gradient allreduce through the collective library
-        flat, treedef = jax.tree_util.tree_flatten(grads)
-        averaged = [
-            collective.allreduce(np.asarray(g), group_name=ctx.collective_group)
-            / ctx.get_world_size()
-            for g in flat
-        ]
-        grads = jax.tree_util.tree_unflatten(treedef, averaged)
+        # data-parallel sync: overlapped bucketed allreduce, joined at
+        # the (immediately following) optimizer apply
+        grads = train.grad_sync(grads).join()
         params = jax.tree.map(lambda p, g: p - lr * jnp.asarray(g), params, grads)
 
         if config.get("crash_at") is not None and step == config["crash_at"]:

@@ -52,6 +52,11 @@ HOT_PATHS = (
     # ~100 B rendezvous records may pack in-band (opted out per line)
     os.path.join("ray_tpu", "collective", "p2p.py"),
     os.path.join("ray_tpu", "collective", "collective.py"),
+    # bucketed grad sync: multi-MB gradient buckets go through
+    # p2p.send_async as raw ndarrays (out-of-band segments); only the
+    # coalesced KV-fallback exchange may pack — and _exchange is a KV
+    # publish, not an RPC send, so it stays clean by construction
+    os.path.join("ray_tpu", "collective", "bucketed.py"),
     # compiled-graph / compiled-pipeline exec loops: microbatch
     # activations move via channel writes — see CHANNEL_SEND_PATHS
     os.path.join("ray_tpu", "dag.py"),
