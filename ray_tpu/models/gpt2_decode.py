@@ -278,6 +278,44 @@ def sample(logits, temps, greedy_mask, rng):
     return jnp.where(greedy_mask, greedy, sampled).astype(jnp.int32)
 
 
+@partial(jax.jit, donate_argnums=(1, 2, 3))
+def update_rows(last_tokens, lengths, temps, greedy_mask, rows, row_last,
+                row_len, row_temps, row_greedy):
+    """Incremental decode-state update: write admission/retirement
+    values into ``rows`` of the device-resident step state WITHOUT
+    re-uploading the full arrays — the async decode pipeline's
+    steady-state churn path (one small scatter per array instead of
+    five host->device transfers at every admit/retire).
+
+    ``last_tokens`` is deliberately NOT donated: in the single-step
+    decode regime it aliases the chunk's token output, which the host
+    may not have materialized yet (the in-flight lookahead)."""
+    return (
+        last_tokens.at[rows].set(row_last),
+        lengths.at[rows].set(row_len),
+        temps.at[rows].set(row_temps),
+        greedy_mask.at[rows].set(row_greedy),
+    )
+
+
+@partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+def update_rows_paged(last_tokens, lengths, temps, greedy_mask,
+                      page_tables, rows, row_last, row_len, row_temps,
+                      row_greedy, row_tables):
+    """Paged twin of :func:`update_rows`: also rewrites the changed
+    sequences' page-table rows (a retired row's table goes all-zero so
+    its junk scatters land in the scratch page; an admitted row brings
+    its freshly reserved table). Same donation caveat on
+    ``last_tokens``."""
+    return (
+        last_tokens.at[rows].set(row_last),
+        lengths.at[rows].set(row_len),
+        temps.at[rows].set(row_temps),
+        greedy_mask.at[rows].set(row_greedy),
+        page_tables.at[rows].set(row_tables),
+    )
+
+
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(4, 5))
 def decode_and_sample(cfg: GPT2Config, params, last_tokens, lengths,
                       cache_k, cache_v, temps, greedy_mask, rng_base, step):

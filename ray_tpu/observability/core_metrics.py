@@ -133,6 +133,14 @@ def _build() -> dict:
             boundaries=_LATENCY_BOUNDS,
             tag_keys=("deployment",),
         ),
+        "serve_decode_host_gap_s": Histogram(
+            "rt_serve_decode_host_gap_s",
+            "host time between consecutive decode dispatches while the "
+            "device sat idle with work available; ~0 when the async "
+            "decode pipeline keeps a lookahead chunk in flight",
+            boundaries=_LATENCY_BOUNDS,
+            tag_keys=("deployment",),
+        ),
         "serve_tokens_generated": Counter(
             "rt_serve_tokens_generated_total",
             "tokens generated by the LLM engine",

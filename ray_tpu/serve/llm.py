@@ -43,6 +43,7 @@ class LLMConfig:
         max_concurrency: int = 16,
         engine: str = "kv",  # "kv" (cached decode) | "recompute" (legacy)
         paged_kv: Optional[bool] = None,  # None = RT_SERVE_PAGED_KV
+        async_decode: Optional[bool] = None,  # None = RT_SERVE_ASYNC_DECODE
     ):
         self.model_id = model_id
         self.num_replicas = num_replicas
@@ -61,6 +62,10 @@ class LLMConfig:
         # bench_serve's interleaved A/B arms can pick their engine
         # without touching replica-process environments.
         self.paged_kv = paged_kv
+        # Async decode pipeline (one-step lookahead): an explicit bool
+        # overrides RT_SERVE_ASYNC_DECODE the same way, so bench_serve's
+        # asyncdecode leg can A/B it per arm through the pickled spec.
+        self.async_decode = async_decode
 
 
 class _Request:
@@ -99,6 +104,28 @@ class _Request:
             self.token_q = queue.Queue()
 
 
+class _Chunk:
+    """One dispatched-but-unharvested decode chunk (the async pipeline's
+    in-flight lookahead). The engine dispatches chunk N+1 from chunk N's
+    device-resident outputs BEFORE materializing chunk N's tokens; this
+    record carries everything the later harvest needs: the device token
+    array, the (row, seq, finish_pending) set captured at dispatch, rows
+    cancelled while the chunk was in flight (their tokens are dropped on
+    the host), and pages whose free is deferred until this chunk — the
+    last one that can scatter into them — has completed."""
+
+    __slots__ = ("toks_dev", "n_steps", "rows", "by_row", "dropped",
+                 "free_after")
+
+    def __init__(self, toks_dev, n_steps: int):
+        self.toks_dev = toks_dev  # [K, S] (or [S] when K == 1) on device
+        self.n_steps = n_steps
+        self.rows: List[tuple] = []  # (row, seq, finish_pending)
+        self.by_row: Dict[int, Any] = {}
+        self.dropped: set = set()  # rows cancelled mid-flight
+        self.free_after: List[int] = []  # pages released at harvest
+
+
 class _PagedSeq:
     """One live sequence in the paged engine: the request it serves,
     its page pins, and its prefill/decode cursors. Admission reserves
@@ -108,7 +135,8 @@ class _PagedSeq:
 
     __slots__ = ("req", "prompt", "pages", "released", "digests", "n_hit",
                  "table", "cached_tokens", "prefill_pos", "length",
-                 "produced", "last_token", "t_last", "ttft_us", "active")
+                 "produced", "last_token", "t_last", "ttft_us", "active",
+                 "budget_left")
 
     def __init__(self, req: _Request, prompt: List[int]):
         self.req = req
@@ -131,13 +159,18 @@ class _PagedSeq:
         self.t_last: Optional[float] = None
         self.ttft_us = 0
         self.active = False  # prefill complete, decoding
+        # decode steps this sequence may still be dispatched for;
+        # decremented AT DISPATCH (not harvest) so the pipelined loop
+        # knows deterministically, before any token materializes, which
+        # rows finish in the chunk it just launched
+        self.budget_left = 0
 
 
 class _Slot:
     """One occupied KV-cache row: the request it serves + its cursor."""
 
     __slots__ = ("req", "length", "produced", "last_token", "t_last",
-                 "pool", "pool_refs", "cached", "ttft_us")
+                 "pool", "pool_refs", "cached", "ttft_us", "budget_left")
 
     def __init__(self, req: _Request, length: int, first_token: int):
         self.req = req
@@ -153,6 +186,7 @@ class _Slot:
         self.pool_refs: List[str] = []
         self.cached = False
         self.ttft_us = 0
+        self.budget_left = 0  # see _PagedSeq.budget_left
 
 
 class LLMServer:
@@ -192,6 +226,14 @@ class LLMServer:
             self._paged = (
                 bool(config.paged_kv) if config.paged_kv is not None
                 else bool(rtcfg.serve_paged_kv)
+            )
+            # one-step lookahead pipeline; RT_SERVE_ASYNC_DECODE=0 (or
+            # async_decode=False in the spec) restores the synchronous
+            # dispatch->harvest loop
+            self._async_decode = (
+                bool(config.async_decode)
+                if config.async_decode is not None
+                else bool(rtcfg.serve_async_decode)
             )
             if self._paged:
                 # ONE page pool holds generation and prefix KV. Default
@@ -382,12 +424,23 @@ class LLMServer:
         lengths = np.zeros((S,), np.int32)
         temps = np.zeros((S,), np.float32)
         greedy = np.ones((S,), bool)
-        # device-resident copies of the step state: re-uploaded only when
-        # admissions/finishes change them, so the steady decode loop is
-        # one dispatch per token
+        # device-resident copies of the step state: fully uploaded only
+        # at (re)build; admissions/retirements push JUST their rows via
+        # dec.update_rows, so steady-state churn never stalls the
+        # pipeline behind four host->device transfers
         dev_state = None  # (last, lengths, temps, greedy) on device
+        dirty: set = set()  # rows whose host state must reach the device
         rng_base = self._rng
         step_no = 0
+        # async decode pipeline (RT_SERVE_ASYNC_DECODE): at most ONE
+        # dispatched-but-unharvested chunk; None in sync mode or when
+        # the pipeline is drained
+        async_mode = self._async_decode
+        inflight: Optional[_Chunk] = None
+        # monotonic stamp of the moment the device ran dry with work
+        # still active; the next dispatch observes the span as
+        # rt_serve_decode_host_gap_s (0 when a lookahead kept it busy)
+        gap_start: Optional[float] = None
 
         def _bucket(n: int, cap: int) -> int:
             # next power of two: one compile per bucket, and a short
@@ -510,10 +563,12 @@ class LLMServer:
                 # zero-token completions must not leak the sampled-but-
                 # unrequested first token into the stream
                 req.token_q.put(first)
+            slot.budget_left = min(req.max_new - 1, T_max - 1 - prompt_len)
             last[i] = first
             lengths[i] = prompt_len
             temps[i] = max(req.temperature, 1e-6)
             greedy[i] = req.temperature <= 0
+            dirty.add(i)
 
         def release_refs(s: _Slot) -> None:
             # the request is leaving its slot: drop its prefix-block refs
@@ -522,51 +577,209 @@ class LLMServer:
                 s.pool.release(s.pool_refs)
                 s.pool_refs = []
 
-        def finish(i: int) -> None:
-            slot = slots[i]
+        def retire(i: int) -> None:
+            """Row i leaves the decode batch: zero its host state so the
+            next dispatch's incremental row push parks it on junk-safe
+            values (length 0 => the junk token scatters at position 0 of
+            a free row, overwritten by the next admission's prefill —
+            which the device executes after any in-flight chunk)."""
+            s = slots[i]
             slots[i] = None
-            release_refs(slot)
-            slot.req.result = slot.produced[: slot.req.max_new]
-            if tracing.ENABLED and slot.req.trace_id and slot.req.t0_us:
+            release_refs(s)
+            last[i] = 0
+            lengths[i] = 0
+            temps[i] = 1e-6
+            greedy[i] = True
+            dirty.add(i)
+
+        def complete(s: _Slot) -> None:
+            s.req.result = s.produced[: s.req.max_new]
+            if tracing.ENABLED and s.req.trace_id and s.req.t0_us:
                 tracing.emit(tracing.request_span(
-                    slot.req.trace_id, tracing.ENGINE, self.cfg.model_id,
-                    slot.req.t0_us, tracing.now_us() - slot.req.t0_us,
-                    tokens=len(slot.req.result),
-                    cached=slot.cached, ttft_us=slot.ttft_us,
+                    s.req.trace_id, tracing.ENGINE, self.cfg.model_id,
+                    s.req.t0_us, tracing.now_us() - s.req.t0_us,
+                    tokens=len(s.req.result),
+                    cached=s.cached, ttft_us=s.ttft_us,
                 ))
-            slot.req.event.set()
-            if slot.req.token_q is not None:
-                slot.req.token_q.put(None)  # end of stream
+            s.req.event.set()
+            if s.req.token_q is not None:
+                s.req.token_q.put(None)  # end of stream
+
+        def finish(i: int) -> None:
+            s = slots[i]
+            retire(i)
+            complete(s)
 
         def fail_inflight(e: BaseException) -> None:
             # One poisoned round must not turn the replica into a black
             # hole (the guard the legacy _batch_loop had): fail every
-            # occupied slot's request and keep serving.
+            # occupied slot's request — including rows whose finish was
+            # scheduled at dispatch but whose chunk never harvested —
+            # and keep serving.
+            nonlocal inflight
             for i in range(S):
                 if slots[i] is not None:
-                    release_refs(slots[i])
-                    slots[i].req.error = e
-                    slots[i].req.event.set()
-                    if slots[i].req.token_q is not None:
-                        slots[i].req.token_q.put(None)
-                    slots[i] = None
+                    s = slots[i]
+                    retire(i)
+                    self._fail_request(s.req, e)
+            if inflight is not None:
+                rec, inflight = inflight, None
+                for _i, s, fin in rec.rows:
+                    if fin:
+                        self._fail_request(s.req, e)
+
+        def harvest(rec: _Chunk, drained: bool) -> None:
+            """Materialize a dispatched chunk's tokens and run all its
+            host bookkeeping: fan-out, SSE queue puts, metric stamps,
+            completions. In async mode this executes while the NEXT
+            chunk (already dispatched) keeps the device busy —
+            np.asarray is the only sync point."""
+            nonlocal gap_start
+            toks = np.asarray(rec.toks_dev)
+            if toks.ndim == 1:
+                toks = toks[None]  # [1, S]
+            if drained and core_metrics.ENABLED:
+                # no younger chunk in flight: the device just ran dry
+                # and stays dry until the next dispatch — that span is
+                # the host gap the async pipeline exists to hide
+                gap_start = time.monotonic()
+            n_new = rec.n_steps
+            live = [r for r in rec.rows if r[0] not in rec.dropped]
+            if core_metrics.ENABLED:
+                # every live row receives exactly n_steps tokens (the
+                # chunk was bounded by the minimum remaining budget)
+                now = time.monotonic()
+                dep_tags = {"deployment": self.cfg.model_id}
+                core_metrics.serve_tokens_generated.inc(
+                    n_new * len(live), tags=dep_tags
+                )
+                for _i, s, _fin in live:
+                    if s.t_last is not None:
+                        core_metrics.serve_inter_token_s.observe(
+                            (now - s.t_last) / n_new, tags=dep_tags
+                        )
+                    s.t_last = now
+            for k in range(n_new):
+                for i, s, _fin in live:
+                    s.length += 1
+                    s.last_token = int(toks[k, i])
+                    s.produced.append(s.last_token)
+                    if (
+                        s.req.token_q is not None
+                        and not s.req.cancelled
+                        and len(s.produced) > 1  # first token sent at admit
+                        and len(s.produced) <= s.req.max_new
+                    ):
+                        s.req.token_q.put(s.last_token)
+            for i, s, fin in live:
+                if fin:
+                    complete(s)
+                elif slots[i] is s:
+                    # keep the host mirror accurate for full rebuilds
+                    last[i] = s.last_token
+                    lengths[i] = s.length
+
+        def dispatch(active: List[int], waiting: bool) -> _Chunk:
+            nonlocal cache_k, cache_v, dev_state, step_no, gap_start
+            if dev_state is None:
+                dev_state = (
+                    jnp.asarray(last), jnp.asarray(lengths),
+                    jnp.asarray(temps), jnp.asarray(greedy),
+                )
+                dirty.clear()
+            elif dirty:
+                # incremental dev_state: scatter ONLY the changed rows
+                # (admits/retires) into the device-resident step state
+                # instead of re-uploading all four arrays
+                idx = np.asarray(sorted(dirty), np.int32)
+                d_last, d_len, d_temps, d_greedy = dev_state
+                dev_state = dec.update_rows(
+                    d_last, d_len, d_temps, d_greedy,
+                    jnp.asarray(idx), jnp.asarray(last[idx]),
+                    jnp.asarray(lengths[idx]), jnp.asarray(temps[idx]),
+                    jnp.asarray(greedy[idx]),
+                )
+                dirty.clear()
+            d_last, d_len, d_temps, d_greedy = dev_state
+            # Chunk size: as many tokens as every active slot still
+            # needs (bounded), but single-step whenever requests are
+            # waiting so admission latency stays one step.
+            K = 1
+            if not waiting:
+                K = max(1, min(8, min(
+                    slots[i].budget_left for i in active
+                )))
+            if core_metrics.ENABLED:
+                core_metrics.serve_decode_host_gap_s.observe(
+                    (time.monotonic() - gap_start)
+                    if gap_start is not None else 0.0,
+                    tags={"deployment": self.cfg.model_id},
+                )
+            gap_start = None
+            self._record_step(len(active))
+            if K > 1:
+                toks_dev, d_last2, d_len, cache_k, cache_v = (
+                    dec.decode_multi(
+                        mcfg, self.params, d_last, d_len, cache_k,
+                        cache_v, d_temps, d_greedy, rng_base, K, step_no,
+                    )
+                )
+                step_no += K
+                dev_state = (d_last2, d_len, d_temps, d_greedy)
+            else:
+                step_no += 1
+                toks_dev, d_len, cache_k, cache_v = dec.decode_and_sample(
+                    mcfg, self.params, d_last, d_len, cache_k, cache_v,
+                    d_temps, d_greedy, rng_base, step_no,
+                )
+                dev_state = (toks_dev, d_len, d_temps, d_greedy)
+            rec = _Chunk(toks_dev, K)
+            for i in active:
+                s = slots[i]
+                s.budget_left -= K
+                fin = s.budget_left <= 0
+                rec.rows.append((i, s, fin))
+                rec.by_row[i] = s
+                if fin:
+                    # deterministic finish (budgets, not token values,
+                    # end generations here): the row leaves the batch
+                    # AT DISPATCH so the next chunk never includes it
+                    # and its slot is immediately reusable; token
+                    # fan-out and completion happen at harvest
+                    retire(i)
+            return rec
 
         def one_round() -> None:
-            """One continuous-batching round: admit → decode chunk →
-            bookkeeping."""
-            nonlocal cache_k, cache_v, dev_state, step_no
+            """One continuous-batching round: reap/admit -> dispatch the
+            next chunk -> harvest the previous one (async lookahead) or
+            this one (sync)."""
+            nonlocal cache_k, cache_v, dev_state, inflight, gap_start
             if cache_k is None:  # rebuild after a poisoned (donated) round
                 cache_k, cache_v = dec.init_cache(mcfg, S, T_max)
+                dev_state = None
+                dirty.clear()
+            # consume the wake flag BEFORE the queue/cancel scans: a
+            # set() landing after the scans stays pending for the idle
+            # wait below, so an idle engine can never sleep through a
+            # request that arrived between scan and wait (the old
+            # wait-then-clear order could eat exactly that wakeup — up
+            # to 500 ms of TTFT on an idle engine)
+            self._work.clear()
             # reap abandoned requests (client disconnected mid-stream):
             # their KV rows go back to the free pool instead of decoding
             # to max_new for nobody
             for i in range(S):
                 s = slots[i]
                 if s is not None and s.req.cancelled:
-                    slots[i] = None
-                    release_refs(s)
+                    if (
+                        inflight is not None
+                        and inflight.by_row.get(i) is s
+                    ):
+                        # mid-lookahead cancel: the in-flight chunk's
+                        # tokens for this row drop at harvest
+                        inflight.dropped.add(i)
+                    retire(i)
                     s.req.event.set()
-                    dev_state = None
             # admit new requests into free slots (continuous batching)
             admitted = False
             for i in range(S):
@@ -582,9 +795,8 @@ class LLMServer:
                     break
                 admit(i, req)
                 admitted = True
-                dev_state = None
             active = [i for i in range(S) if slots[i] is not None]
-            # single-token answers (or prefill failures) finish immediately
+            # single-token answers (or 0-token asks) finish immediately
             for i in list(active):
                 s = slots[i]
                 if len(s.produced) >= s.req.max_new or s.length >= T_max - 1:
@@ -592,98 +804,26 @@ class LLMServer:
             active = [i for i in range(S) if slots[i] is not None]
             self._occupied = len(active)
             if not active:
-                if not admitted:
+                if inflight is not None:
+                    # drain the lookahead before idling: its tokens are
+                    # real and its pending finishes must complete
+                    rec, inflight = inflight, None
+                    harvest(rec, True)
+                elif not admitted:
                     self._work.wait(timeout=0.5)
-                    self._work.clear()
+                gap_start = None
                 return
-            if dev_state is None:
-                dev_state = (
-                    jnp.asarray(last), jnp.asarray(lengths),
-                    jnp.asarray(temps), jnp.asarray(greedy),
-                )
-            d_last, d_len, d_temps, d_greedy = dev_state
-            # Chunk size: as many tokens as every active slot still needs
-            # (bounded), but single-step whenever requests are waiting so
-            # admission latency stays one step.
             with self._lock:
                 waiting = bool(self._queue)
-            K = 1
-            if not waiting:
-                K = min(
-                    8,
-                    min(
-                        min(
-                            slots[i].req.max_new - len(slots[i].produced),
-                            T_max - 1 - slots[i].length,
-                        )
-                        for i in active
-                    ),
-                )
-                K = max(K, 1)
-            self._record_step(len(active))
-            if K > 1:
-                toks_dev, d_last2, d_len, cache_k, cache_v = dec.decode_multi(
-                    mcfg, self.params, d_last, d_len, cache_k, cache_v,
-                    d_temps, d_greedy, rng_base, K, step_no,
-                )
-                step_no += K
-                dev_state = (d_last2, d_len, d_temps, d_greedy)
-                toks = np.asarray(toks_dev)  # [K, S]
+            rec = dispatch(active, waiting)
+            if async_mode:
+                # one-step lookahead: chunk N+1 is on the device; run
+                # chunk N's host bookkeeping underneath it
+                prev, inflight = inflight, rec
+                if prev is not None:
+                    harvest(prev, False)
             else:
-                step_no += 1
-                nxt_dev, d_len, cache_k, cache_v = dec.decode_and_sample(
-                    mcfg, self.params, d_last, d_len, cache_k, cache_v,
-                    d_temps, d_greedy, rng_base, step_no,
-                )
-                dev_state = (nxt_dev, d_len, d_temps, d_greedy)
-                toks = np.asarray(nxt_dev)[None]  # [1, S]
-            if core_metrics.ENABLED:
-                # every active slot receives exactly toks.shape[0] tokens
-                # this round (the chunk is bounded by the minimum
-                # remaining budget across active slots)
-                now = time.monotonic()
-                n_new = toks.shape[0]
-                dep_tags = {"deployment": self.cfg.model_id}
-                core_metrics.serve_tokens_generated.inc(
-                    n_new * len(active), tags=dep_tags
-                )
-                for i in active:
-                    s = slots[i]
-                    if s is None:
-                        continue
-                    if s.t_last is not None:
-                        core_metrics.serve_inter_token_s.observe(
-                            (now - s.t_last) / n_new, tags=dep_tags
-                        )
-                    s.t_last = now
-            changed = False
-            for k in range(toks.shape[0]):
-                for i in active:
-                    s = slots[i]
-                    if s is None:  # finished at an earlier k of this chunk
-                        continue
-                    s.length += 1
-                    s.last_token = int(toks[k, i])
-                    s.produced.append(s.last_token)
-                    if (
-                        s.req.token_q is not None
-                        and len(s.produced) > 1  # first token sent at admit
-                        and len(s.produced) <= s.req.max_new
-                    ):
-                        s.req.token_q.put(s.last_token)
-                    last[i] = s.last_token
-                    lengths[i] = s.length
-                    if (
-                        len(s.produced) >= s.req.max_new
-                        or s.length >= T_max - 1
-                    ):
-                        finish(i)
-                        changed = True
-            if changed:
-                # inactive rows would keep decoding junk forever; harmless
-                # numerically (their cache rows are reused on admit) but
-                # forcing a state re-upload keeps lengths honest
-                dev_state = None
+                harvest(rec, True)
 
         while not self._stop.is_set():
             try:
@@ -696,6 +836,8 @@ class LLMServer:
                 )
                 fail_inflight(e)
                 dev_state = None
+                dirty.clear()
+                gap_start = None
                 # prefill/decode donate the caches (donate_argnums): an
                 # exception raised after dispatch leaves cache_k/cache_v
                 # pointing at deleted buffers on TPU, so every later round
@@ -781,11 +923,22 @@ class LLMServer:
         lengths = np.zeros((S,), np.int32)
         temps = np.zeros((S,), np.float32)
         greedy = np.ones((S,), bool)
-        # device-resident step state (incl. page tables): re-uploaded
-        # only when admissions/finishes change it
+        # device-resident step state (incl. page tables): fully uploaded
+        # only at (re)build; admissions/retirements push JUST their rows
+        # via dec.update_rows_paged
         dev_state = None
+        dirty: set = set()  # rows whose host state must reach the device
         rng_base = self._rng
         step_no = 0
+        # async decode pipeline (RT_SERVE_ASYNC_DECODE): at most ONE
+        # dispatched-but-unharvested chunk; None in sync mode or when
+        # the pipeline is drained
+        async_mode = self._async_decode
+        inflight: Optional[_Chunk] = None
+        # monotonic stamp of the moment the device ran dry with work
+        # still active; the next dispatch observes the span as
+        # rt_serve_decode_host_gap_s (0 when a lookahead kept it busy)
+        gap_start: Optional[float] = None
 
         def _bucket(n: int, cap: int) -> int:
             p = 16
@@ -793,39 +946,49 @@ class LLMServer:
                 p *= 2
             return min(p, cap)
 
-        def release_once(s: _PagedSeq) -> None:
-            # pages return to the pool EXACTLY once, however many of
-            # finish/cancel/fail/unload race for this sequence — a
-            # second release would decref pages another sequence may
-            # already have re-allocated
-            if not s.released:
-                s.released = True
-                pages, s.pages = s.pages, []
-                pool.release_pages(pages)
+        def take_pages(s: _PagedSeq) -> List[int]:
+            # a sequence's pages leave it EXACTLY once, however many of
+            # finish/cancel/fail/unload race for it — a second release
+            # would decref pages another sequence may already have
+            # re-allocated
+            if s.released:
+                return []
+            s.released = True
+            pages, s.pages = s.pages, []
+            return pages
 
-        def retire(i: int) -> None:
-            nonlocal dev_state
+        def retire(i: int, rec: Optional[_Chunk] = None) -> None:
+            """Row i leaves the decode batch. Its pages free NOW unless
+            an in-flight chunk still scatters into them (``rec``): then
+            the free is DEFERRED until that chunk is harvested — one
+            step — so a lookahead never reads (or writes) a freed page
+            that admission re-allocated underneath it."""
             s = seqs[i]
             seqs[i] = None
-            release_once(s)
             tables[i] = 0  # this row's junk scatters -> scratch page
             lengths[i] = 0
-            dev_state = None
+            last[i] = 0
+            dirty.add(i)
+            pages = take_pages(s)
+            if rec is not None:
+                rec.free_after.extend(pages)
+            elif pages:
+                pool.release_pages(pages)
 
         def activate(i: int, s: _PagedSeq, first: int, kv_len: int) -> None:
             """Prefill (or import) complete: the sequence joins the
             decode batch at position ``kv_len`` with ``first`` sampled."""
-            nonlocal dev_state
             s.active = True
             s.length = kv_len
             s.produced = [first]
             s.last_token = first
+            s.budget_left = min(s.req.max_new - 1, T_max - 1 - kv_len)
             tables[i] = s.table
             last[i] = first
             lengths[i] = kv_len
             temps[i] = max(s.req.temperature, 1e-6)
             greedy[i] = s.req.temperature <= 0
-            dev_state = None
+            dirty.add(i)
             if tracing.ENABLED and s.req.t0_us:
                 s.ttft_us = tracing.now_us() - s.req.t0_us
             if core_metrics.ENABLED:
@@ -970,9 +1133,7 @@ class LLMServer:
                     first = self._sample_one(logits, s.req.temperature)
                     activate(i, s, int(first), len(s.prompt))
 
-        def finish(i: int) -> None:
-            s = seqs[i]
-            retire(i)
+        def complete(s: _PagedSeq) -> None:
             s.req.result = s.produced[: s.req.max_new]
             if tracing.ENABLED and s.req.trace_id and s.req.t0_us:
                 tracing.emit(tracing.request_span(
@@ -985,15 +1146,166 @@ class LLMServer:
             if s.req.token_q is not None:
                 s.req.token_q.put(None)  # end of stream
 
+        def finish(i: int) -> None:
+            s = seqs[i]
+            retire(i)
+            complete(s)
+
         def fail_inflight(e: BaseException) -> None:
+            nonlocal inflight
             for i in range(S):
                 if seqs[i] is not None:
                     s = seqs[i]
                     retire(i)
                     self._fail_request(s.req, e)
+            if inflight is not None:
+                # the lookahead chunk dies unharvested: release its
+                # deferred pages (the pool resets with the cache rebuild
+                # anyway — this keeps occupancy honest even if the
+                # rebuild itself keeps failing) and fail the requests
+                # whose finish was scheduled at its dispatch
+                rec, inflight = inflight, None
+                if rec.free_after:
+                    pool.release_pages(rec.free_after)
+                    rec.free_after = []
+                for _i, s, fin in rec.rows:
+                    if fin:
+                        self._fail_request(s.req, e)
+
+        def harvest(rec: _Chunk, drained: bool) -> None:
+            """Materialize a dispatched chunk's tokens and run all its
+            host bookkeeping: fan-out, SSE queue puts, metric stamps,
+            completions, deferred page frees. In async mode this
+            executes while the NEXT chunk (already dispatched) keeps
+            the device busy — np.asarray is the only sync point."""
+            nonlocal gap_start
+            toks = np.asarray(rec.toks_dev)
+            if toks.ndim == 1:
+                toks = toks[None]  # [1, S]
+            if drained and core_metrics.ENABLED:
+                # no younger chunk in flight: the device just ran dry
+                # and stays dry until the next dispatch — that span is
+                # the host gap the async pipeline exists to hide
+                gap_start = time.monotonic()
+            n_new = rec.n_steps
+            live = [r for r in rec.rows if r[0] not in rec.dropped]
+            if core_metrics.ENABLED:
+                now = time.monotonic()
+                dep_tags = {"deployment": self.cfg.model_id}
+                core_metrics.serve_tokens_generated.inc(
+                    n_new * len(live), tags=dep_tags
+                )
+                for _i, s, _fin in live:
+                    if s.t_last is not None:
+                        core_metrics.serve_inter_token_s.observe(
+                            (now - s.t_last) / n_new, tags=dep_tags
+                        )
+                    s.t_last = now
+            for k in range(n_new):
+                for i, s, _fin in live:
+                    s.length += 1
+                    s.last_token = int(toks[k, i])
+                    s.produced.append(s.last_token)
+                    if (
+                        s.req.token_q is not None
+                        and not s.req.cancelled
+                        and len(s.produced) > 1  # first sent at activate
+                        and len(s.produced) <= s.req.max_new
+                    ):
+                        s.req.token_q.put(s.last_token)
+            for i, s, fin in live:
+                if fin:
+                    complete(s)
+                elif seqs[i] is s:
+                    # keep the host mirror accurate for full rebuilds
+                    last[i] = s.last_token
+                    lengths[i] = s.length
+            if rec.free_after:
+                # deferred frees: this chunk was the last dispatch that
+                # could scatter into these pages — they are now safe to
+                # re-allocate
+                pool.release_pages(rec.free_after)
+                rec.free_after = []
+
+        def dispatch(active: List[int], waiting: bool,
+                     prefilling: bool) -> _Chunk:
+            nonlocal cache_k, cache_v, dev_state, step_no, gap_start
+            if dev_state is None:
+                dev_state = (
+                    jnp.asarray(last), jnp.asarray(lengths),
+                    jnp.asarray(temps), jnp.asarray(greedy),
+                    jnp.asarray(tables),
+                )
+                dirty.clear()
+            elif dirty:
+                # incremental dev_state: scatter ONLY the changed rows
+                # (admits/retires) into the device-resident step state
+                # instead of re-uploading all five arrays
+                idx = np.asarray(sorted(dirty), np.int32)
+                d_last, d_len, d_temps, d_greedy, d_tables = dev_state
+                dev_state = dec.update_rows_paged(
+                    d_last, d_len, d_temps, d_greedy, d_tables,
+                    jnp.asarray(idx), jnp.asarray(last[idx]),
+                    jnp.asarray(lengths[idx]), jnp.asarray(temps[idx]),
+                    jnp.asarray(greedy[idx]), jnp.asarray(tables[idx]),
+                )
+                dirty.clear()
+            d_last, d_len, d_temps, d_greedy, d_tables = dev_state
+            # Chunk size: single-step while requests wait for admission
+            # OR any sequence is mid-prefill (the next prefill chunk
+            # must interleave after ONE decode step, or ITL for live
+            # streams would stretch by the whole chunk).
+            K = 1
+            if not waiting and not prefilling:
+                K = max(1, min(8, min(
+                    seqs[i].budget_left for i in active
+                )))
+            if core_metrics.ENABLED:
+                core_metrics.serve_decode_host_gap_s.observe(
+                    (time.monotonic() - gap_start)
+                    if gap_start is not None else 0.0,
+                    tags={"deployment": self.cfg.model_id},
+                )
+            gap_start = None
+            self._record_step_paged(len(active), pool.stats())
+            if K > 1:
+                toks_dev, d_last2, d_len, cache_k, cache_v = (
+                    dec.decode_multi_paged(
+                        mcfg, self.params, d_last, d_len, cache_k,
+                        cache_v, d_tables, d_temps, d_greedy, rng_base,
+                        K, step_no,
+                    )
+                )
+                step_no += K
+                dev_state = (d_last2, d_len, d_temps, d_greedy, d_tables)
+            else:
+                step_no += 1
+                toks_dev, d_len, cache_k, cache_v = (
+                    dec.decode_paged_and_sample(
+                        mcfg, self.params, d_last, d_len, cache_k,
+                        cache_v, d_tables, d_temps, d_greedy, rng_base,
+                        step_no,
+                    )
+                )
+                dev_state = (toks_dev, d_len, d_temps, d_greedy, d_tables)
+            rec = _Chunk(toks_dev, K)
+            for i in active:
+                s = seqs[i]
+                s.budget_left -= K
+                fin = s.budget_left <= 0
+                rec.rows.append((i, s, fin))
+                rec.by_row[i] = s
+                if fin:
+                    # deterministic finish (budgets, not token values,
+                    # end generations here): the row leaves the batch
+                    # AT DISPATCH so the next chunk never includes it;
+                    # its pages free when THIS chunk — the last one
+                    # scattering into them — is harvested
+                    retire(i, rec)
+            return rec
 
         def one_round() -> None:
-            nonlocal cache_k, cache_v, dev_state, step_no
+            nonlocal cache_k, cache_v, dev_state, inflight, gap_start
             if cache_k is None:
                 # rebuild after a poisoned (donated) round. The pool's
                 # sealed pages pointed into the deleted cache, so ALL
@@ -1001,12 +1313,32 @@ class LLMServer:
                 # copies and could survive this; the page pool cannot)
                 cache_k, cache_v = dec.init_paged_cache(mcfg, n_phys, B)
                 pool.reset()
+                dev_state = None
+                dirty.clear()
+            # consume the wake flag BEFORE the queue/cancel scans: a
+            # set() landing after the scans stays pending for the idle
+            # wait below, so an idle engine can never sleep through a
+            # request that arrived between scan and wait (the old
+            # wait-then-clear order could eat exactly that wakeup — up
+            # to 500 ms of TTFT on an idle engine)
+            self._work.clear()
             # reap abandoned requests: their pages go back to the pool
             # instead of decoding to max_new for nobody
             for i in range(S):
                 s = seqs[i]
                 if s is not None and s.req.cancelled:
-                    retire(i)
+                    rec = (
+                        inflight
+                        if inflight is not None
+                        and inflight.by_row.get(i) is s
+                        else None
+                    )
+                    if rec is not None:
+                        # mid-lookahead cancel: the in-flight chunk's
+                        # tokens for this row drop at harvest, and its
+                        # pages free only once that chunk completes
+                        rec.dropped.add(i)
+                    retire(i, rec)
                     s.req.event.set()
             admitted = False
             for i in range(S):
@@ -1046,96 +1378,26 @@ class LLMServer:
             ]
             self._occupied = len(active)
             if not active:
-                if not admitted and not prefilling:
+                if inflight is not None:
+                    # drain the lookahead before idling: its tokens are
+                    # real and its pending finishes must complete
+                    rec, inflight = inflight, None
+                    harvest(rec, True)
+                elif not admitted and not prefilling:
                     self._work.wait(timeout=0.5)
-                    self._work.clear()
+                gap_start = None
                 return
-            if dev_state is None:
-                dev_state = (
-                    jnp.asarray(last), jnp.asarray(lengths),
-                    jnp.asarray(temps), jnp.asarray(greedy),
-                    jnp.asarray(tables),
-                )
-            d_last, d_len, d_temps, d_greedy, d_tables = dev_state
-            # Chunk size: single-step while requests wait for admission
-            # OR any sequence is mid-prefill (the next prefill chunk
-            # must interleave after ONE decode step, or ITL for live
-            # streams would stretch by the whole chunk).
             with self._lock:
                 waiting = bool(self._queue)
-            K = 1
-            if not waiting and not prefilling:
-                K = min(
-                    8,
-                    min(
-                        min(
-                            seqs[i].req.max_new - len(seqs[i].produced),
-                            T_max - 1 - seqs[i].length,
-                        )
-                        for i in active
-                    ),
-                )
-                K = max(K, 1)
-            self._record_step_paged(len(active), pool.stats())
-            if K > 1:
-                toks_dev, d_last2, d_len, cache_k, cache_v = (
-                    dec.decode_multi_paged(
-                        mcfg, self.params, d_last, d_len, cache_k,
-                        cache_v, d_tables, d_temps, d_greedy, rng_base,
-                        K, step_no,
-                    )
-                )
-                step_no += K
-                dev_state = (d_last2, d_len, d_temps, d_greedy, d_tables)
-                toks = np.asarray(toks_dev)  # [K, S]
+            rec = dispatch(active, waiting, prefilling)
+            if async_mode:
+                # one-step lookahead: chunk N+1 is on the device; run
+                # chunk N's host bookkeeping underneath it
+                prev, inflight = inflight, rec
+                if prev is not None:
+                    harvest(prev, False)
             else:
-                step_no += 1
-                nxt_dev, d_len, cache_k, cache_v = (
-                    dec.decode_paged_and_sample(
-                        mcfg, self.params, d_last, d_len, cache_k,
-                        cache_v, d_tables, d_temps, d_greedy, rng_base,
-                        step_no,
-                    )
-                )
-                dev_state = (nxt_dev, d_len, d_temps, d_greedy, d_tables)
-                toks = np.asarray(nxt_dev)[None]  # [1, S]
-            if core_metrics.ENABLED:
-                now = time.monotonic()
-                n_new = toks.shape[0]
-                dep_tags = {"deployment": self.cfg.model_id}
-                core_metrics.serve_tokens_generated.inc(
-                    n_new * len(active), tags=dep_tags
-                )
-                for i in active:
-                    s = seqs[i]
-                    if s is None:
-                        continue
-                    if s.t_last is not None:
-                        core_metrics.serve_inter_token_s.observe(
-                            (now - s.t_last) / n_new, tags=dep_tags
-                        )
-                    s.t_last = now
-            for k in range(toks.shape[0]):
-                for i in active:
-                    s = seqs[i]
-                    if s is None:  # finished at an earlier k of this chunk
-                        continue
-                    s.length += 1
-                    s.last_token = int(toks[k, i])
-                    s.produced.append(s.last_token)
-                    if (
-                        s.req.token_q is not None
-                        and len(s.produced) > 1  # first sent at activate
-                        and len(s.produced) <= s.req.max_new
-                    ):
-                        s.req.token_q.put(s.last_token)
-                    last[i] = s.last_token
-                    lengths[i] = s.length
-                    if (
-                        len(s.produced) >= s.req.max_new
-                        or s.length >= T_max - 1
-                    ):
-                        finish(i)  # retire() resets dev_state
+                harvest(rec, True)
 
         while not self._stop.is_set():
             try:
@@ -1149,6 +1411,8 @@ class LLMServer:
                 )
                 fail_inflight(e)
                 dev_state = None
+                dirty.clear()
+                gap_start = None
                 # prefill/decode/write donate the caches: an exception
                 # after dispatch leaves them deleted — mark for rebuild
                 # (done inside the next round's try, with a pool.reset

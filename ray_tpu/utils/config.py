@@ -302,6 +302,16 @@ config.define("serve_paged_max_seqs", 0)
 # with decode steps (bounding in-flight streams' ITL and per-step
 # memory). 0 = unchunked (a prompt prefills in one round).
 config.define("serve_prefill_chunk_tokens", 512)
+# Async decode pipeline (serve/llm.py): the engine dispatches decode
+# chunk N+1 from chunk N's device-resident outputs BEFORE materializing
+# chunk N's tokens on the host, so token fan-out, SSE queue puts,
+# metrics stamps and the admission scan overlap with device compute
+# (one-step lookahead). Page frees are deferred by one step so an
+# in-flight chunk never reads freed pages. RT_SERVE_ASYNC_DECODE=0 is
+# the kill switch (and the A/B lever for bench_serve's asyncdecode
+# leg): the engine harvests every chunk synchronously before the next
+# dispatch, exactly the pre-pipeline loop.
+config.define("serve_async_decode", True)
 # Disaggregated prefill/decode (serve/kv_transfer.py): the ingress
 # calls a separate prefill deployment which ships the slot's KV rows
 # back over an RpcChannel (zero-copy multiseg frames); the local engine

@@ -10,19 +10,30 @@ from ray_tpu.core.cluster_utils import Cluster
 from ray_tpu.core.placement import PlacementGroupSchedulingStrategy
 
 
-@pytest.fixture
-def cluster():
+@pytest.fixture(scope="module")
+def _shared_cluster():
+    # ONE head for the whole module (the other of the tier-1 sweep's
+    # two slowest cluster spinners): tests add nodes under test-unique
+    # resource tags and kill only nodes they added, so sharing the head
+    # never leaks scheduling surface between tests.
     c = Cluster()
-    yield c
     try:
-        ray_tpu.shutdown()
+        yield c
     finally:
         c.shutdown()
 
 
+@pytest.fixture
+def cluster(_shared_cluster):
+    try:
+        yield _shared_cluster
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_multi_node_spread(cluster):
-    cluster.add_node(num_cpus=2, resources={"tag_a": 1})
-    cluster.add_node(num_cpus=2, resources={"tag_b": 1})
+    node_a = cluster.add_node(num_cpus=2, resources={"tag_a": 1})
+    node_b = cluster.add_node(num_cpus=2, resources={"tag_b": 1})
     ray_tpu.init(address=cluster.address)
 
     @ray_tpu.remote
@@ -35,7 +46,7 @@ def test_multi_node_spread(cluster):
     a = ray_tpu.get(where.options(resources={"tag_a": 1}).remote())
     b = ray_tpu.get(where.options(resources={"tag_b": 1}).remote())
     assert a != b
-    assert {a, b} == {n.node_id for n in cluster.nodes}
+    assert {a, b} == {node_a.node_id, node_b.node_id}
 
 
 def test_strict_spread_pg_across_nodes(cluster):
@@ -146,12 +157,17 @@ def test_pg_replaced_after_node_death(cluster):
     bundle moves to a live node, surviving bundle locations are untouched,
     and new leases against the re-placed bundle succeed (reference:
     GcsPlacementGroupManager reschedules bundles on node death)."""
-    keeper = cluster.add_node(num_cpus=2)
-    victim = cluster.add_node(num_cpus=2)
-    spare = cluster.add_node(num_cpus=2)
+    # the "pgz" tag pins bundles to THIS test's three nodes (the shared
+    # module cluster has live nodes from earlier tests)
+    keeper = cluster.add_node(num_cpus=2, resources={"pgz": 2})
+    victim = cluster.add_node(num_cpus=2, resources={"pgz": 2})
+    spare = cluster.add_node(num_cpus=2, resources={"pgz": 2})
     ray_tpu.init(address=cluster.address)
 
-    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1, "pgz": 1}, {"CPU": 1, "pgz": 1}],
+        strategy="STRICT_SPREAD",
+    )
     assert pg.wait(20)
     locs = pg.table()["bundle_locations"]
     nodes_used = set(locs.values())

@@ -10,16 +10,26 @@ import ray_tpu
 from ray_tpu.core.cluster_utils import Cluster
 
 
-@pytest.fixture
-def cluster():
+@pytest.fixture(scope="module")
+def _shared_cluster():
+    # ONE head for the whole module: each test adds its own nodes under
+    # module-unique resource tags and kills only nodes it added, so the
+    # per-test surface stays isolated while the expensive head spin-up
+    # and full-cluster teardown (~10 s each) happen once, not five times
+    # — this module was the tier-1 sweep's slowest cluster spinner.
     c = Cluster()
     try:
         yield c
     finally:
-        try:
-            ray_tpu.shutdown()
-        finally:
-            c.shutdown()
+        c.shutdown()
+
+
+@pytest.fixture
+def cluster(_shared_cluster):
+    try:
+        yield _shared_cluster
+    finally:
+        ray_tpu.shutdown()
 
 
 def test_owner_get_recovers_lost_object(cluster):
